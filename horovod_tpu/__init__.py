@@ -1,0 +1,56 @@
+"""horovod_tpu — a TPU-native distributed training framework.
+
+A from-scratch rebuild of the capabilities of Horovod v0.15.1 (reference at
+/root/reference) designed for TPU hardware: process identity comes from the
+pod-slice topology instead of ``mpirun`` (basics.py); the collective data
+plane is XLA AllReduce/AllGather/CollectivePermute compiled over a
+``jax.sharding.Mesh`` riding ICI/DCN instead of MPI/NCCL (ops/); gradient
+fusion is a trace-time flat-bucket transform instead of a background-thread
+staging buffer (ops/fusion.py); and the dynamic/eager API keeps a native C++
+coordination engine for cross-host op ordering (core/), which SPMD lockstep
+makes unnecessary on the compiled path.
+
+Typical use (JAX, data-parallel — analog of reference README.md:148-226)::
+
+    import horovod_tpu as hvd
+    hvd.init()
+    step = hvd.shard(my_step, in_specs=..., out_specs=...)
+    # inside my_step: grads = hvd.grouped_allreduce(grads)  # fused psum
+"""
+
+from horovod_tpu.basics import (  # noqa: F401
+    NotInitializedError,
+    chips_per_slice,
+    cross_rank,
+    cross_size,
+    init,
+    is_initialized,
+    local_num_chips,
+    local_rank,
+    local_size,
+    mpi_threads_supported,
+    num_chips,
+    rank,
+    shutdown,
+    size,
+)
+from horovod_tpu.mesh import (  # noqa: F401
+    DATA_AXIS,
+    data_sharding,
+    data_spec,
+    global_mesh,
+    replicated_sharding,
+)
+from horovod_tpu.ops import (  # noqa: F401
+    Compression,
+    allgather,
+    allreduce,
+    allreduce_sparse,
+    batch_spec,
+    broadcast,
+    grouped_allreduce,
+    shard,
+    sparse_to_dense,
+)
+
+__version__ = "0.1.0"

@@ -1,0 +1,233 @@
+"""Process identity and topology — the TPU-native replacement for ``mpirun``.
+
+The reference derives rank/local_rank/cross_rank by ``MPI_Init_thread`` plus
+communicator splits (``MPI_Comm_split_type(SHARED)`` for the node-local
+communicator, ``MPI_Comm_split`` for the cross-node one — reference:
+horovod/common/operations.cc:1465-1532), with one OS process per accelerator
+launched by ``mpirun``.
+
+On TPU there is no launcher: the pod runtime hands every JAX process its
+coordinates (``jax.process_index()``/``jax.process_count()``) and each process
+drives *all* the chips attached to its host.  That single difference shapes the
+whole design, so we expose BOTH granularities explicitly:
+
+* **process level** (``rank``/``size``/``local_rank``/``local_size``) — mirrors
+  the reference's process semantics for everything that happens in eager
+  Python: data sharding, rank-0 checkpointing, logging, eager collectives.
+  ``rank()==0`` is the reference's coordinator rank.
+* **chip level** (``num_chips``/``chip_ranks``) — the data-parallel width used
+  *inside* compiled programs.  The SPMD mesh axis ``"hvd"`` spans all chips;
+  learning-rate scaling and gradient averaging divide by ``num_chips()``, the
+  analog of the reference's ``hvd.size()`` when one process drove one GPU.
+
+``cross_rank``/``cross_size`` map the reference's inter-node communicator onto
+TPU slice topology (slice index / number of slices) and feed the hierarchical
+ICI+DCN reduction (see parallel/hierarchy.py).
+"""
+
+from __future__ import annotations
+
+import atexit
+import dataclasses
+import os
+import threading
+
+import jax
+
+
+class NotInitializedError(RuntimeError):
+    """Raised when the API is used before ``init()``.
+
+    Mirrors the reference's ``CheckInitialized`` → ``NOT_INITIALIZED_ERROR``
+    (horovod/common/operations.cc:256-263, 1929-1934).
+    """
+
+    def __init__(self) -> None:
+        super().__init__(
+            "horovod_tpu has not been initialized; call horovod_tpu.init() first."
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """Immutable snapshot of the pod-slice topology taken at ``init()``."""
+
+    rank: int              # this process's index among all processes
+    size: int              # number of processes
+    local_rank: int        # index of this process among processes on its host
+    local_size: int        # processes on this host (JAX: 1 per host)
+    cross_rank: int        # slice index of this process's chips
+    cross_size: int        # number of slices in the job
+    num_chips: int         # total accelerator count (data-parallel width)
+    local_num_chips: int   # chips driven by this process
+    chips_per_slice: int
+
+
+_lock = threading.Lock()
+_topology: Topology | None = None
+
+
+def _detect_slices(devices) -> tuple[int, int]:
+    """Return (slice_index_of_first_local_device, num_slices).
+
+    Multi-slice TPU jobs expose ``device.slice_index``; single-slice jobs and
+    CPU simulation do not, in which case every chip is in slice 0.  This is the
+    analog of the reference's cross-node communicator split
+    (operations.cc:1499-1532) with "slice" standing in for "node": ICI links
+    chips within a slice, DCN links slices.
+    """
+    slice_ids = sorted({getattr(d, "slice_index", 0) for d in devices})
+    local = jax.local_devices()
+    my_slice = getattr(local[0], "slice_index", 0) if local else 0
+    return slice_ids.index(my_slice), max(len(slice_ids), 1)
+
+
+def init(*, distributed: bool | None = None, coordinator_address: str | None = None,
+         num_processes: int | None = None, process_id: int | None = None,
+         mesh_axes: dict[str, int] | None = None) -> None:
+    """Initialize horovod_tpu — the analog of ``hvd.init()``.
+
+    Unlike the reference (which boots MPI, reference operations.cc:1435-1663),
+    no launcher is required: topology comes from the TPU pod runtime.  For
+    multi-host jobs outside a managed pod environment, pass
+    ``coordinator_address``/``num_processes``/``process_id`` (or set the
+    standard JAX env vars) and we call ``jax.distributed.initialize``.
+
+    ``mesh_axes`` adds model-parallel axes (name → size) to the global mesh
+    next to the data axis, e.g. ``{"tp": 4}``; data-parallel width becomes
+    ``num_chips / prod(mesh_axes)``.
+
+    Safe to call more than once (subsequent calls are no-ops), matching
+    ``InitializeHorovodOnce`` (reference operations.cc:1907-1925).
+    """
+    global _topology
+    with _lock:
+        if _topology is not None:
+            return
+        # Decide on jax.distributed BEFORE touching any jax API that would
+        # initialise the XLA backend (initialize() refuses to run after that).
+        if coordinator_address is None:
+            coordinator_address = os.environ.get("JAX_COORDINATOR_ADDRESS")
+        if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+            num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+        if process_id is None and "JAX_PROCESS_ID" in os.environ:
+            process_id = int(os.environ["JAX_PROCESS_ID"])
+        want_dist = distributed
+        if want_dist is None:
+            want_dist = coordinator_address is not None
+        if want_dist:
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=coordinator_address,
+                    num_processes=num_processes,
+                    process_id=process_id,
+                )
+            except RuntimeError:
+                # Either the user already initialised jax.distributed (fine —
+                # topology below is still correct) or the backend was touched
+                # first in a genuinely single-process run.
+                if jax.process_count() == 1 and (num_processes or 1) > 1:
+                    raise
+        devices = jax.devices()
+        local = jax.local_devices()
+        cross_rank, cross_size = _detect_slices(devices)
+        # JAX runs one process per host, so the host-local "communicator"
+        # contains exactly this process; local_rank mirrors the reference's
+        # node-local rank used for device pinning (N/A on TPU, kept for API
+        # parity with reference common/__init__.py:104-121).
+        topo = Topology(
+            rank=jax.process_index(),
+            size=jax.process_count(),
+            local_rank=0,
+            local_size=1,
+            cross_rank=cross_rank,
+            cross_size=cross_size,
+            num_chips=len(devices),
+            local_num_chips=len(local),
+            chips_per_slice=max(len(devices) // max(cross_size, 1), 1),
+        )
+        # Build the global mesh BEFORE publishing topology so a mesh failure
+        # leaves the process cleanly un-initialized (re-init can retry);
+        # mirrors comm setup at reference operations.cc:1484-1532.
+        from horovod_tpu import mesh as _mesh
+
+        _mesh.build_global_mesh(mesh_axes, cross_size=cross_size)
+        _topology = topo
+    atexit.register(shutdown)  # reference common/__init__.py:69
+
+
+def shutdown() -> None:
+    """Tear down background machinery — analog of ``horovod_shutdown``
+    (reference operations.cc:1947-1985).  Idempotent."""
+    global _topology
+    with _lock:
+        if _topology is None:
+            return
+        _topology = None
+    from horovod_tpu.core import engine as _engine
+
+    _engine.shutdown_engine()
+    from horovod_tpu import mesh as _mesh
+
+    _mesh.reset()
+
+
+def is_initialized() -> bool:
+    return _topology is not None
+
+
+def _topo() -> Topology:
+    if _topology is None:
+        raise NotInitializedError()
+    return _topology
+
+
+def rank() -> int:
+    """Process rank (0 is the coordinator; use for checkpoint/log gating)."""
+    return _topo().rank
+
+
+def size() -> int:
+    """Number of processes (data shards for host-side input pipelines)."""
+    return _topo().size
+
+
+def local_rank() -> int:
+    return _topo().local_rank
+
+
+def local_size() -> int:
+    return _topo().local_size
+
+
+def cross_rank() -> int:
+    """Slice index — reference's inter-node rank (operations.cc:1516-1532)."""
+    return _topo().cross_rank
+
+
+def cross_size() -> int:
+    """Number of slices — reference's inter-node size."""
+    return _topo().cross_size
+
+
+def num_chips() -> int:
+    """Total accelerators = data-parallel width (use for LR scaling)."""
+    return _topo().num_chips
+
+
+def local_num_chips() -> int:
+    return _topo().local_num_chips
+
+
+def chips_per_slice() -> int:
+    return _topo().chips_per_slice
+
+
+def mpi_threads_supported() -> bool:
+    """API-parity shim for reference common/__init__.py:147-154.
+
+    There is no MPI on the TPU path; the runtime is always safe to drive from
+    multiple Python threads, so this is unconditionally True.
+    """
+    _topo()
+    return True

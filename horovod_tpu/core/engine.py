@@ -1,0 +1,17 @@
+"""Python driver for the native coordination engine (see core/src/).
+
+Full async-handle machinery lands with the C++ core; this module always
+exposes ``shutdown_engine`` so ``basics.shutdown`` can tear down whatever is
+running (analog of reference operations.cc:1947-1985).
+"""
+
+from __future__ import annotations
+
+_engine = None
+
+
+def shutdown_engine() -> None:
+    global _engine
+    if _engine is not None:
+        _engine.shutdown()
+        _engine = None

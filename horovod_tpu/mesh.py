@@ -1,0 +1,141 @@
+"""Global device-mesh management — the TPU replacement for communicators.
+
+The reference keeps three MPI communicators per process (global ``mpi_comm``,
+node-local ``local_comm``, inter-node ``cross_comm`` — reference:
+horovod/common/operations.cc:1484-1532) and caches NCCL communicators keyed by
+device vectors (operations.cc:894-931).  The TPU-native analog is a single
+:class:`jax.sharding.Mesh` built once at ``init()``:
+
+* single-slice jobs get a 1-D mesh with axis ``"hvd"`` over every chip — the
+  data-parallel axis all collectives ride (pure ICI);
+* multi-slice jobs get a 2-D mesh ``("dcn", "ici")`` where ``ici`` spans chips
+  within a slice and ``dcn`` spans slices — the analog of
+  local_comm × cross_comm, and the substrate for hierarchical allreduce
+  (reference operations.cc:1025-1177; ours in parallel/hierarchy.py).
+
+XLA compiles collectives against this mesh and routes them over ICI links
+in-slice and DCN between slices; there is nothing to bootstrap at runtime
+(no ``ncclUniqueId`` exchange) because placement is static.
+
+The mesh is deliberately *extensible*: ``build_global_mesh`` accepts extra
+model axes (tensor/pipeline/sequence/expert) so the data-parallel design never
+precludes other parallelism strategies (see parallel/).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+DATA_AXIS = "hvd"      # 1-D data-parallel axis (single slice)
+ICI_AXIS = "ici"       # intra-slice axis (2-D hierarchical mesh)
+DCN_AXIS = "dcn"       # inter-slice axis (2-D hierarchical mesh)
+
+_lock = threading.Lock()
+_mesh: Mesh | None = None
+_data_axes: tuple[str, ...] = (DATA_AXIS,)
+
+
+def build_global_mesh(extra_axes: dict[str, int] | None = None, *,
+                      cross_size: int | None = None) -> Mesh:
+    """Create (or return) the process-wide mesh.
+
+    ``extra_axes`` maps model-parallel axis names to sizes; the data-parallel
+    width becomes ``num_chips / prod(extra_axes)``.  Device order follows
+    JAX's topology-aware ordering so neighbouring mesh coordinates are
+    ICI neighbours (the property the reference got from NCCL ring setup).
+
+    Once built, the mesh is fixed for the life of the process (like the
+    reference's communicators): asking for different ``extra_axes`` later is
+    an error — pass ``mesh_axes`` to ``init()`` instead.
+    """
+    global _mesh, _data_axes
+    with _lock:
+        if _mesh is not None:
+            if extra_axes and any(a not in _mesh.axis_names or
+                                  _mesh.shape[a] != s
+                                  for a, s in extra_axes.items()):
+                raise RuntimeError(
+                    f"global mesh already built with axes "
+                    f"{dict(_mesh.shape)}; requested extra axes {extra_axes} "
+                    f"cannot be applied. Pass mesh_axes= to horovod_tpu.init()."
+                )
+            return _mesh
+        from horovod_tpu import basics
+
+        devices = jax.devices()
+        n = len(devices)
+        if cross_size is not None:
+            cross = cross_size
+        else:
+            cross = basics.cross_size() if basics.is_initialized() else 1
+        model = 1
+        extra_axes = extra_axes or {}
+        for v in extra_axes.values():
+            model *= v
+        if n % model != 0:
+            raise ValueError(
+                f"extra mesh axes {extra_axes} (product {model}) do not divide "
+                f"device count {n}"
+            )
+        dp = n // model
+        if cross > 1:
+            # Multi-slice: put DCN as the outermost (slowest-varying) axis so
+            # in-slice collectives never cross DCN.
+            from jax.experimental import mesh_utils
+
+            per_slice = dp // cross
+            mesh_devices = mesh_utils.create_hybrid_device_mesh(
+                mesh_shape=(per_slice, *extra_axes.values()),
+                dcn_mesh_shape=(cross,) + (1,) * len(extra_axes),
+                devices=devices,
+            )
+            axes = (DCN_AXIS, ICI_AXIS, *extra_axes.keys())
+            mesh_devices = mesh_devices.reshape(cross, per_slice, *extra_axes.values())
+            _mesh = Mesh(mesh_devices, axes)
+            _data_axes = (DCN_AXIS, ICI_AXIS)
+        else:
+            axes = (DATA_AXIS, *extra_axes.keys())
+            arr = np.asarray(devices).reshape(dp, *extra_axes.values())
+            _mesh = Mesh(arr, axes)
+            _data_axes = (DATA_AXIS,)
+        return _mesh
+
+
+def global_mesh() -> Mesh:
+    if _mesh is None:
+        from horovod_tpu.basics import NotInitializedError
+
+        raise NotInitializedError()
+    return _mesh
+
+
+def data_axes() -> tuple[str, ...]:
+    """Mesh axis name(s) spanning all data-parallel chips."""
+    return _data_axes
+
+
+def data_spec(ndim: int, batch_dim: int = 0) -> PartitionSpec:
+    """PartitionSpec sharding dimension ``batch_dim`` across the data axes."""
+    spec: list = [None] * ndim
+    spec[batch_dim] = _data_axes if len(_data_axes) > 1 else _data_axes[0]
+    return PartitionSpec(*spec)
+
+
+def data_sharding(ndim: int, batch_dim: int = 0) -> NamedSharding:
+    return NamedSharding(global_mesh(), data_spec(ndim, batch_dim))
+
+
+def replicated_sharding() -> NamedSharding:
+    return NamedSharding(global_mesh(), PartitionSpec())
+
+
+def reset() -> None:
+    """Drop the cached mesh (used by ``shutdown()`` and tests)."""
+    global _mesh, _data_axes
+    with _lock:
+        _mesh = None
+        _data_axes = (DATA_AXIS,)

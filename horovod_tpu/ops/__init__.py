@@ -1,0 +1,11 @@
+from horovod_tpu.ops.collective_ops import (  # noqa: F401
+    allgather,
+    allreduce,
+    allreduce_sparse,
+    batch_spec,
+    broadcast,
+    grouped_allreduce,
+    shard,
+    sparse_to_dense,
+)
+from horovod_tpu.ops.compression import Compression  # noqa: F401

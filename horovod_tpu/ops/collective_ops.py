@@ -1,0 +1,258 @@
+"""Named-tensor collectives — the XLA data plane.
+
+This is the analog of the reference's op layer
+(horovod/tensorflow/mpi_ops.py:57-182, horovod/torch/mpi_ops.py) and of the
+execution half of ``PerformOperation`` (horovod/common/operations.cc:714-1362),
+with one structural difference that defines the whole rebuild: on TPU the
+collectives are *compiled*, not dispatched.  ``jax.lax.psum`` / ``all_gather``
+/ masked-``psum`` broadcast inside a ``shard_map`` over the global mesh become
+XLA AllReduce/AllGather HLOs that the compiler schedules, fuses, and overlaps
+on ICI — there is no background thread, fusion memcpy, or readiness
+negotiation on this path because SPMD lockstep makes every chip reach the
+collective in the same program order (SURVEY §7 hard-part (a)).
+
+Two calling contexts are supported by every op:
+
+* **in-mesh** (inside ``shard_map``/``pmap`` with the data axis bound): the op
+  lowers straight to ``lax`` collectives over the chip axis.  This is the hot
+  path used by ``DistributedOptimizer`` and the train-step builders.
+* **eager** (plain Python, no trace): process-level semantics — each process
+  contributes its host value, like one reference rank per host.  Used for
+  bootstrap (broadcast_parameters), metrics averaging, and the torch binding.
+  Ragged ``allgather`` (per-rank dim-0 sizes, reference's ``MPI_Allgatherv``
+  path operations.cc:1273-1332) is supported here, where shapes may be dynamic.
+
+Gradient semantics match the reference's registered gradients
+(tensorflow/mpi_ops.py:95-182): grad(allreduce)=allreduce, grad(allgather)=
+reduce-scatter of the gathered grad, grad(broadcast)=psum zeroed off-root —
+all of which fall out of JAX autodiff on the primitives we use, rather than
+being hand-registered.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import multihost_utils
+from jax.sharding import NamedSharding, PartitionSpec
+
+from horovod_tpu import basics, mesh
+from horovod_tpu.ops.compression import Compression
+from horovod_tpu.ops import fusion
+
+Average = True  # default matches reference allreduce(average=True)
+
+
+def _in_mesh_axes() -> tuple[str, ...] | None:
+    """Return the data axis names if we are tracing under a mesh context with
+    them bound (shard_map/pmap), else None."""
+    axes = mesh.data_axes()
+    try:
+        lax.axis_index(axes if len(axes) > 1 else axes[0])
+        return axes
+    except NameError:
+        return None
+
+
+def _data_width(axes: tuple[str, ...]) -> int:
+    """Number of workers spanned by the data axes (NOT total devices: the
+    mesh may carry extra model-parallel axes that collectives don't cross)."""
+    n = 1
+    for a in axes:
+        n *= lax.axis_size(a)
+    return n
+
+
+def _require_not_traced(name: str) -> None:
+    core = jax.core
+    if isinstance(jnp.zeros(()), core.Tracer):  # pragma: no cover - safety net
+        raise RuntimeError(
+            f"horovod_tpu.{name} was called inside jit without the data mesh "
+            f"axis in scope; wrap your step with horovod_tpu.shard (or "
+            f"shard_map over the global mesh) so collectives have an axis to "
+            f"reduce over."
+        )
+
+
+# ---------------------------------------------------------------------------
+# allreduce
+# ---------------------------------------------------------------------------
+
+def allreduce(tensor, average: bool = True, name: str | None = None,
+              compression=Compression.none, prescale_factor: float = 1.0):
+    """Sum (or average) ``tensor`` across all workers.
+
+    In-mesh: one ``lax.psum`` over the chip axis (the reference's fused
+    MPI_Allreduce/ncclAllReduce, operations.cc:954-1311).  Eager: process-level
+    reduction.  ``compression`` casts to the wire dtype around the collective
+    (reference tensorflow/__init__.py:80-87).
+    """
+    axes = _in_mesh_axes()
+    compressed, ctx = compression.compress(tensor)
+    if prescale_factor != 1.0:
+        compressed = compressed * prescale_factor
+    if axes is not None:
+        reduced = lax.psum(compressed, axes)
+        if average:
+            reduced = reduced / _data_width(axes)
+    else:
+        _require_not_traced("allreduce")
+        reduced = _eager_process_reduce(compressed)
+        if average:
+            reduced = reduced / basics.size()
+    return compression.decompress(reduced, ctx)
+
+
+def grouped_allreduce(tensors: Sequence, average: bool = True,
+                      compression=Compression.none,
+                      threshold_bytes: int | None = None) -> list:
+    """Fused allreduce of many tensors via flat buckets (reference fusion
+    buffer semantics, operations.cc:1807-1842; see ops/fusion.py)."""
+    axes = _in_mesh_axes()
+    comp = [compression.compress(t) for t in tensors]
+    if axes is not None:
+        denom = _data_width(axes)
+        reduced = fusion.fused_apply(
+            [c for c, _ in comp],
+            lambda flat: lax.psum(flat, axes), threshold_bytes)
+    else:
+        _require_not_traced("grouped_allreduce")
+        denom = basics.size()
+        reduced = [_eager_process_reduce(c) for c, _ in comp]
+    if average:
+        reduced = [r / denom for r in reduced]
+    return [compression.decompress(r, ctx) for r, (_, ctx) in zip(reduced, comp)]
+
+
+def _eager_process_reduce(x):
+    if basics.size() == 1:
+        return jnp.asarray(x)
+    gathered = multihost_utils.process_allgather(jnp.asarray(x)[None], tiled=False)
+    return jnp.sum(gathered.reshape((basics.size(),) + jnp.shape(x)), axis=0)
+
+
+# ---------------------------------------------------------------------------
+# allgather
+# ---------------------------------------------------------------------------
+
+def allgather(tensor, name: str | None = None):
+    """Concatenate each worker's tensor along dim 0.
+
+    In-mesh: ``lax.all_gather(tiled=True)`` — requires equal per-chip shapes
+    (XLA static-shape constraint).  Eager: supports per-process *different*
+    dim-0 sizes, reproducing the reference's ``MPI_Allgatherv`` (response
+    carries per-rank dim-0 sizes, operations.cc:576-612, 1273-1332) by
+    gathering sizes first, padding to the max, then slicing.
+    """
+    axes = _in_mesh_axes()
+    if axes is not None:
+        flat_axis = axes if len(axes) > 1 else axes[0]
+        return lax.all_gather(tensor, flat_axis, tiled=True)
+    _require_not_traced("allgather")
+    tensor = jnp.asarray(tensor)
+    if basics.size() == 1:
+        return tensor
+    dim0 = jnp.shape(tensor)[0] if tensor.ndim else 1
+    sizes = multihost_utils.process_allgather(jnp.array([dim0]), tiled=False)
+    sizes = sizes.reshape(-1)
+    max_d = int(sizes.max())
+    pad = [(0, max_d - dim0)] + [(0, 0)] * (tensor.ndim - 1)
+    padded = jnp.pad(tensor, pad)
+    gathered = multihost_utils.process_allgather(padded[None], tiled=False)
+    gathered = gathered.reshape((basics.size(), max_d) + tensor.shape[1:])
+    pieces = [gathered[r, : int(sizes[r])] for r in range(basics.size())]
+    return jnp.concatenate(pieces, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# broadcast
+# ---------------------------------------------------------------------------
+
+def broadcast(tensor, root_rank: int = 0, name: str | None = None):
+    """Every worker receives ``root_rank``'s value (reference MPI_Bcast path,
+    operations.cc:1333-1353).
+
+    In-mesh this is a masked ``psum``: zero every shard except the root's and
+    sum — one AllReduce on ICI, and autodiff yields exactly the reference's
+    registered broadcast gradient (psum of the cotangent, zeroed off-root;
+    tensorflow/mpi_ops.py:146-161) with no custom rule.
+    """
+    axes = _in_mesh_axes()
+    if axes is not None:
+        # axis_index over a tuple gives the linearized index across the
+        # (possibly factored dcn×ici) data axes.
+        idx = lax.axis_index(axes if len(axes) > 1 else axes[0])
+        orig_dtype = tensor.dtype
+        work = tensor
+        if not jnp.issubdtype(orig_dtype, jnp.inexact):
+            work = work.astype(jnp.float32) if orig_dtype == jnp.bool_ else work
+        masked = jnp.where(idx == root_rank, work,
+                           jnp.zeros_like(work))
+        out = lax.psum(masked, axes)
+        return out.astype(orig_dtype)
+    _require_not_traced("broadcast")
+    if basics.size() == 1:
+        return jnp.asarray(tensor)
+    return multihost_utils.broadcast_one_to_all(
+        jnp.asarray(tensor), is_source=basics.rank() == root_rank)
+
+
+# ---------------------------------------------------------------------------
+# sparse (IndexedSlices analog)
+# ---------------------------------------------------------------------------
+
+def allreduce_sparse(values, indices, dense_dim0: int | None = None,
+                     average: bool = True):
+    """Sparse gradient reduction — the reference's ``tf.IndexedSlices`` path,
+    which allgathers values and indices instead of allreducing a dense tensor
+    (reference tensorflow/__init__.py:67-78).
+
+    Returns (gathered_values, gathered_indices); with ``average`` the values
+    are pre-divided by the worker count, matching the reference.  Callers that
+    want a dense result can scatter-add into ``dense_dim0`` rows via
+    ``sparse_to_dense``.
+    """
+    axes = _in_mesh_axes()
+    n = _data_width(axes) if axes is not None else basics.size()
+    if average:
+        values = values / n
+    return allgather(values), allgather(indices)
+
+
+def sparse_to_dense(values, indices, dense_dim0: int):
+    out = jnp.zeros((dense_dim0,) + values.shape[1:], values.dtype)
+    return out.at[indices].add(values)
+
+
+# ---------------------------------------------------------------------------
+# shard: the SPMD wrapper users put around a train step
+# ---------------------------------------------------------------------------
+
+def shard(fn=None, *, in_specs=None, out_specs=None, check_vma: bool = False):
+    """Wrap ``fn`` in a ``shard_map`` over the global mesh so in-mesh
+    collectives (``allreduce`` etc.) have the chip axis in scope.
+
+    This replaces the reference's implicit "every process runs the script"
+    SPMD model: instead of N processes each executing the step, one traced
+    program executes on N chips.  Defaults shard/replicate nothing
+    (``in_specs``/``out_specs`` of ``P()``); pass e.g.
+    ``in_specs=(P(), hvd.batch_spec(ndim))`` for data parallelism.
+    """
+    if fn is None:
+        return functools.partial(shard, in_specs=in_specs, out_specs=out_specs,
+                                 check_vma=check_vma)
+    m = mesh.global_mesh()
+    P = PartitionSpec
+    return jax.shard_map(
+        fn, mesh=m,
+        in_specs=P() if in_specs is None else in_specs,
+        out_specs=P() if out_specs is None else out_specs,
+        check_vma=check_vma)
+
+
+def batch_spec(ndim: int, batch_dim: int = 0) -> PartitionSpec:
+    return mesh.data_spec(ndim, batch_dim)

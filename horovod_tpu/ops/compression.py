@@ -1,0 +1,78 @@
+"""Gradient compression — cast-based, like the reference, plus a TPU default.
+
+The reference ships a two-member compression registry (``Compression.none`` /
+``Compression.fp16``) that casts gradients to float16 before the collective
+and back after (reference: horovod/tensorflow/compression.py:24-74 and the
+identical horovod/torch/compression.py).  We reproduce that surface and add
+``Compression.bf16``: on TPU, bfloat16 is the native MXU/ICI format — same
+2x wire-size saving as fp16 with float32's exponent range, so it is the
+recommended compressor.
+
+Compressors are pure functions of arrays, so they compose with ``jit`` and
+autodiff; XLA fuses the casts into the surrounding collective's memory moves.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class Compressor:
+    """Interface: ``compress(tensor) -> (tensor, ctx)``; ``decompress(tensor, ctx)``."""
+
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    """Identity (reference compression.py:27-39)."""
+
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class _CastCompressor(Compressor):
+    wire_dtype: jnp.dtype
+
+    @classmethod
+    def compress(cls, tensor):
+        ctx = tensor.dtype
+        if jnp.issubdtype(ctx, jnp.floating) and ctx != cls.wire_dtype:
+            return tensor.astype(cls.wire_dtype), ctx
+        return tensor, ctx
+
+    @classmethod
+    def decompress(cls, tensor, ctx):
+        if ctx is not None and tensor.dtype != ctx:
+            return tensor.astype(ctx)
+        return tensor
+
+
+class FP16Compressor(_CastCompressor):
+    """float16 wire format (reference compression.py:42-63)."""
+
+    wire_dtype = jnp.float16
+
+
+class BF16Compressor(_CastCompressor):
+    """bfloat16 wire format — TPU-native; not in the reference."""
+
+    wire_dtype = jnp.bfloat16
+
+
+class Compression:
+    """Registry, mirroring reference compression.py:66-74."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

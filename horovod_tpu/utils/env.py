@@ -1,0 +1,61 @@
+"""Environment-variable knob system.
+
+The reference framework configures its runtime exclusively through ``HOROVOD_*``
+environment variables read once at background-thread startup (reference:
+horovod/common/operations.cc:1447-1618, operations.h:53-58).  We keep the same
+names (so reference users' launch scripts keep working) and add ``HVD_TPU_*``
+aliases; the TPU-specific defaults differ where the hardware does:
+
+* ``HOROVOD_FUSION_THRESHOLD`` — fusion-buffer byte budget (default 64 MiB,
+  reference operations.cc:167).  On TPU this bounds the size of the flat
+  bucket we concatenate gradients into before a single ``psum``.
+* ``HOROVOD_CYCLE_TIME`` — background coordination tick in ms (default 5.0,
+  reference operations.cc:155).
+* ``HOROVOD_TIMELINE`` — path for the Chrome-tracing timeline (reference
+  operations.cc:1556-1560).
+* ``HOROVOD_STALL_CHECK_DISABLE`` — disable the 60 s stall warning
+  (reference operations.cc:1603-1606).
+* ``HOROVOD_HIERARCHICAL_ALLREDUCE`` — two-level reduction; on TPU this means
+  intra-slice ICI reduce-scatter + inter-slice DCN allreduce + ICI all-gather
+  (reference operations.cc:1025-1177 did NCCL-intra + MPI-inter).
+"""
+
+from __future__ import annotations
+
+import os
+
+DEFAULT_FUSION_THRESHOLD = 64 * 1024 * 1024
+DEFAULT_CYCLE_TIME_MS = 5.0
+# Reference pads fused hierarchical buffers to local_size * 64 elements
+# (FUSION_BUFFER_ATOMIC_UNIT, operations.h:50).  On TPU we pad flat fusion
+# buffers to the lane width (128) so XLA keeps the reduction fully vectorised.
+FUSION_BUFFER_ATOMIC_UNIT = 128
+STALL_WARNING_TIME_SECONDS = 60.0
+
+
+def _get(name: str, default: str | None = None) -> str | None:
+    """Look up HOROVOD_<name>, falling back to HVD_TPU_<name>."""
+    return os.environ.get("HOROVOD_" + name, os.environ.get("HVD_TPU_" + name, default))
+
+
+def fusion_threshold_bytes() -> int:
+    raw = _get("FUSION_THRESHOLD")
+    return int(raw) if raw else DEFAULT_FUSION_THRESHOLD
+
+
+def cycle_time_ms() -> float:
+    raw = _get("CYCLE_TIME")
+    return float(raw) if raw else DEFAULT_CYCLE_TIME_MS
+
+
+def timeline_path() -> str | None:
+    return _get("TIMELINE")
+
+
+def stall_check_disabled() -> bool:
+    return _get("STALL_CHECK_DISABLE") is not None
+
+
+def hierarchical_allreduce() -> bool:
+    raw = _get("HIERARCHICAL_ALLREDUCE")
+    return bool(raw) and raw not in ("0", "false", "False")

@@ -1,0 +1,45 @@
+"""Topology API tests — analog of reference test/common.py:24-56 rank/size
+validation (there: against PMI/OMPI env vars; here: against JAX topology)."""
+
+import jax
+import pytest
+
+
+def test_not_initialized_error():
+    import horovod_tpu as hvd
+
+    if hvd.is_initialized():
+        pytest.skip("already initialized by another test")
+    with pytest.raises(hvd.NotInitializedError):
+        hvd.rank()
+
+
+def test_rank_size(hvd):
+    assert hvd.rank() == jax.process_index()
+    assert hvd.size() == jax.process_count()
+    assert hvd.num_chips() == jax.device_count() == 8
+    assert hvd.local_num_chips() == 8
+    assert 0 <= hvd.rank() < hvd.size()
+
+
+def test_local_cross(hvd):
+    assert hvd.local_rank() == 0
+    assert hvd.local_size() == 1
+    assert hvd.cross_size() >= 1
+    assert 0 <= hvd.cross_rank() < hvd.cross_size()
+    assert hvd.chips_per_slice() * hvd.cross_size() == hvd.num_chips()
+
+
+def test_init_idempotent(hvd):
+    hvd.init()
+    assert hvd.num_chips() == 8
+
+
+def test_mpi_threads_supported(hvd):
+    assert hvd.mpi_threads_supported() is True
+
+
+def test_mesh(hvd):
+    m = hvd.global_mesh()
+    assert m.devices.size == 8
+    assert "hvd" in m.axis_names or "ici" in m.axis_names

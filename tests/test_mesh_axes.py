@@ -1,0 +1,43 @@
+"""Extensibility: extra model-parallel mesh axes must not change collective
+semantics (data-axis width, not total device count, is the denominator).
+The reference has no model parallelism (SURVEY §2.9); these tests pin down
+the contract that our mesh design leaves room for it."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+
+@pytest.fixture()
+def hvd_tp2():
+    import horovod_tpu as hvd
+
+    hvd.shutdown()
+    hvd.init(mesh_axes={"tp": 2})
+    yield hvd
+    hvd.shutdown()
+    hvd.init()
+
+
+def test_average_uses_data_width(hvd_tp2):
+    hvd = hvd_tp2
+    assert dict(hvd.global_mesh().shape) == {"hvd": 4, "tp": 2}
+    x = jnp.ones((4, 2, 3))
+
+    fn = hvd.shard(lambda v: hvd.allreduce(v, average=True),
+                   in_specs=P("hvd", "tp"), out_specs=P("hvd", "tp"))
+    out = np.asarray(fn(x))
+    # average over the 4-wide data axis of all-ones must be exactly 1.0
+    np.testing.assert_allclose(out, np.ones((4, 2, 3)), rtol=1e-6)
+
+
+def test_mesh_rebuild_conflict_errors(hvd_tp2):
+    from horovod_tpu import mesh
+
+    with pytest.raises(RuntimeError, match="already built"):
+        mesh.build_global_mesh({"pp": 4})
+    # matching request is fine
+    m = mesh.build_global_mesh({"tp": 2})
+    assert m is mesh.global_mesh()
