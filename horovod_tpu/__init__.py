@@ -52,5 +52,13 @@ from horovod_tpu.ops import (  # noqa: F401
     shard,
     sparse_to_dense,
 )
+from horovod_tpu.training import (  # noqa: F401
+    DistributedOptimizer,
+    broadcast_object,
+    broadcast_optimizer_state,
+    broadcast_parameters,
+    scale_learning_rate,
+)
+from horovod_tpu import callbacks  # noqa: F401
 
 __version__ = "0.1.0"

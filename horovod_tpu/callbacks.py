@@ -1,0 +1,215 @@
+"""Training-loop callbacks — the Keras-callback surface, TPU-native.
+
+Reproduces the reference's callback family (reference
+horovod/keras/callbacks_impl.py, re-exported via keras/callbacks.py and
+tensorflow/keras/callbacks.py) for JAX training loops.  Loops call the hooks
+at the same points Keras does::
+
+    cbs = [hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+           hvd.callbacks.MetricAverageCallback(),
+           hvd.callbacks.LearningRateWarmupCallback(initial_lr, warmup_epochs=5)]
+    state = run_callbacks(cbs, "on_train_begin", state)
+
+Since JAX state is immutable, hooks take and return the training state
+(a ``TrainState``-like object with ``.params`` and optionally ``.opt_state``)
+instead of mutating a model in place; LR callbacks publish the current LR via
+``lr()`` which the step consumes through ``optax.inject_hyperparams`` or a
+schedule closure.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import numpy as np
+
+from horovod_tpu import basics, training
+from horovod_tpu.ops import collective_ops
+
+
+class Callback:
+    """Hook points mirror keras.callbacks.Callback."""
+
+    def on_train_begin(self, state):
+        return state
+
+    def on_epoch_begin(self, epoch: int, state):
+        return state
+
+    def on_batch_begin(self, batch: int, state):
+        return state
+
+    def on_epoch_end(self, epoch: int, state, logs: dict | None = None):
+        return state
+
+
+def run_callbacks(callbacks, hook: str, state, *args, **kwargs):
+    for cb in callbacks:
+        state = getattr(cb, hook)(*args, state, **kwargs) if args else \
+            getattr(cb, hook)(state, **kwargs)
+    return state
+
+
+class BroadcastGlobalVariablesCallback(Callback):
+    """Broadcast params (+ optimizer state) from ``root_rank`` at train begin.
+
+    Reference keras/callbacks_impl.py:16-30 / tensorflow/__init__.py:101-133.
+    """
+
+    def __init__(self, root_rank: int = 0):
+        self.root_rank = root_rank
+
+    def on_train_begin(self, state):
+        params = training.broadcast_parameters(state.params, self.root_rank)
+        replace = {"params": params}
+        if hasattr(state, "opt_state"):
+            replace["opt_state"] = training.broadcast_optimizer_state(
+                state.opt_state, self.root_rank)
+        return state.replace(**replace)
+
+
+class MetricAverageCallback(Callback):
+    """Average epoch-end metric logs over all workers in place.
+
+    Reference keras/callbacks_impl.py:33-67 — each metric value is allreduced
+    so rank-0 logging/checkpoint decisions see global numbers.
+    """
+
+    def on_epoch_end(self, epoch: int, state, logs: dict | None = None):
+        if logs:
+            for k, v in list(logs.items()):
+                if isinstance(v, (int, float, np.floating, np.integer)) or (
+                        hasattr(v, "ndim") and getattr(v, "ndim", 1) == 0):
+                    logs[k] = float(np.asarray(
+                        collective_ops.allreduce(np.asarray(v, np.float64)
+                                                 .astype(np.float32),
+                                                 average=True)))
+        return state
+
+
+class _LRCallback(Callback):
+    """Base for LR-mutating callbacks: owns the published scalar LR."""
+
+    def __init__(self, initial_lr: float, momentum_correction: bool = True):
+        self.initial_lr = initial_lr
+        self.momentum_correction = momentum_correction
+        self._current = initial_lr
+        self._prev = initial_lr
+
+    def lr(self) -> float:
+        """Current LR — read by the training step each batch."""
+        return self._current
+
+    def momentum_correction_factor(self) -> float:
+        """Multiply momentum buffers by this when the LR jumps.
+
+        The reference rescales the momentum term so an LR change does not
+        distort accumulated velocity (keras/callbacks_impl.py:70-146,
+        ``restore_momentum``/``momentum_correction`` dance).  With optax,
+        apply to e.g. ``opt_state.trace``: see ``apply_momentum_correction``.
+        """
+        if not self.momentum_correction or self._prev == 0:
+            return 1.0
+        return self._current / self._prev
+
+    def _set(self, lr: float):
+        self._prev, self._current = self._current, lr
+
+
+class LearningRateScheduleCallback(_LRCallback):
+    """Multiplier schedule: LR = initial_lr × multiplier(epoch).
+
+    ``multiplier`` is a float or callable(epoch)->float; active inside
+    [start_epoch, end_epoch).  Reference keras/callbacks_impl.py:70-146.
+    """
+
+    def __init__(self, initial_lr: float, multiplier,
+                 start_epoch: int = 0, end_epoch: int | None = None,
+                 staircase: bool = True, momentum_correction: bool = True,
+                 steps_per_epoch: int | None = None):
+        super().__init__(initial_lr, momentum_correction)
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.steps_per_epoch = steps_per_epoch
+        if callable(multiplier):
+            self.multiplier = multiplier
+        else:
+            self.multiplier = lambda epoch: multiplier
+        self._epoch = 0
+
+    def _in_range(self, epoch) -> bool:
+        return (epoch >= self.start_epoch
+                and (self.end_epoch is None or epoch < self.end_epoch))
+
+    def on_epoch_begin(self, epoch: int, state):
+        self._epoch = epoch
+        if self.staircase and self._in_range(epoch):
+            self._set(self.initial_lr * self.multiplier(epoch))
+        return state
+
+    def on_batch_begin(self, batch: int, state):
+        if not self.staircase and self.steps_per_epoch:
+            epoch = self._epoch + batch / self.steps_per_epoch
+            if self._in_range(epoch):
+                self._set(self.initial_lr * self.multiplier(epoch))
+        return state
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Gradual warmup from 1× to ``num_chips()×`` over ``warmup_epochs``.
+
+    Reference keras/callbacks_impl.py:149-168 ("Accurate, Large Minibatch
+    SGD" recipe): multiplier(epoch) = 1 + (size-1) * epoch / warmup_epochs,
+    smoothly interpolated per batch.
+    """
+
+    def __init__(self, initial_lr: float, warmup_epochs: int = 5,
+                 momentum_correction: bool = True,
+                 steps_per_epoch: int | None = None, verbose: bool = False):
+        size = basics.num_chips() if basics.is_initialized() else 1
+
+        def multiplier(epoch):
+            frac = min(epoch / max(warmup_epochs, 1e-9), 1.0)
+            return 1.0 + frac * (size - 1)
+
+        super().__init__(initial_lr, multiplier, start_epoch=0,
+                         end_epoch=warmup_epochs + 1, staircase=False,
+                         momentum_correction=momentum_correction,
+                         steps_per_epoch=steps_per_epoch or 1)
+        self.verbose = verbose
+        self.warmup_epochs = warmup_epochs
+
+    def on_epoch_end(self, epoch: int, state, logs: dict | None = None):
+        if self.verbose and epoch == self.warmup_epochs and basics.rank() == 0:
+            print(f"Epoch {epoch}: finished gradual learning rate warmup to "
+                  f"{self._current}.")
+        return state
+
+
+def apply_momentum_correction(opt_state, factor: float):
+    """Scale momentum/trace buffers by ``factor`` after an LR jump.
+
+    Works on any optax state whose velocity lives in ``TraceState.trace`` or
+    ``ScaleByMomentumState``-like fields named ``trace``/``mu``.
+    """
+    if factor == 1.0:
+        return opt_state
+
+    def fix(node):
+        if hasattr(node, "trace"):
+            return node._replace(trace=jax.tree.map(lambda t: t * factor,
+                                                    node.trace))
+        return node
+
+    return jax.tree.map(fix, opt_state,
+                        is_leaf=lambda n: hasattr(n, "trace"))
+
+
+def allreduce_metrics(logs: dict) -> dict:
+    """One-shot functional metric averaging (MetricAverageCallback as a fn)."""
+    out = dict(logs)
+    MetricAverageCallback().on_epoch_end(0, None, out)
+    return out

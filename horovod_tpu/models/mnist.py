@@ -1,0 +1,45 @@
+"""MNIST models for the smoke-test examples.
+
+Architectures match the reference examples so accuracy curves are comparable:
+``MnistCNN`` is the conv-conv-fc net from reference examples/pytorch_mnist.py:30-45
+and examples/keras_mnist.py:44-56; ``MnistMLP`` is the 2×2000-unit MLP from
+reference examples/tensorflow_mnist.py:29-45.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MnistCNN(nn.Module):
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        x = nn.Conv(32, (3, 3), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Conv(64, (3, 3), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x.astype(jnp.float32))
+        return x
+
+
+class MnistMLP(nn.Module):
+    num_classes: int = 10
+    hidden: int = 2000
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.hidden)(x))
+        x = nn.relu(nn.Dense(self.hidden)(x))
+        return nn.Dense(self.num_classes)(x)

@@ -1,0 +1,133 @@
+"""Decoder-only Transformer — the long-context flagship.
+
+The reference has no attention code at all (SURVEY §2.9: it predates the
+technique and scales batch, never sequence).  The task brief makes
+long-context first-class, so this model is built for it from the start: the
+attention implementation is *pluggable* — dense causal attention by default,
+or ring attention over a sequence-parallel mesh axis
+(parallel/ring_attention.py) when the sequence dimension is sharded.
+
+TPU-first choices: bf16 compute / f32 params, RMSNorm (one fused rsqrt, no
+mean subtraction), rotary position embeddings computed in f32, GLU MLP with
+MXU-aligned widths, all shapes static.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    num_layers: int = 12
+    num_heads: int = 8
+    head_dim: int = 64
+    embed_dim: int = 512
+    mlp_dim: int = 2048
+    max_seq_len: int = 2048
+    dtype: Any = jnp.bfloat16
+    # attention_fn(q, k, v, causal) -> out; shapes [B, S, H, D].  None = dense
+    # causal attention.  parallel/ring_attention.py provides a drop-in for
+    # sequence-sharded q/k/v.
+    attention_fn: Callable | None = None
+    # Offset added to query positions — under sequence parallelism each shard
+    # passes shard_index * shard_len so RoPE and the causal mask see global
+    # positions.
+    rope_theta: float = 10000.0
+
+
+def rope(x, positions, theta: float):
+    """Rotary embeddings; x: [B, S, H, D], positions: [B, S] (f32 math)."""
+    d = x.shape[-1]
+    freq = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    angles = positions[..., None].astype(jnp.float32) * freq  # [B, S, d/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def dense_causal_attention(q, k, v, causal: bool = True):
+    """Reference attention: one softmax(QKᵀ)V, causal-masked. [B, S, H, D]."""
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        s_q, s_k = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), dtype=bool), k=s_k - s_q)
+        logits = jnp.where(mask, logits, -1e30)
+    probs = nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        proj = lambda name: nn.DenseGeneral(  # noqa: E731
+            (cfg.num_heads, cfg.head_dim), use_bias=False, dtype=cfg.dtype,
+            name=name)
+        q = rope(proj("q")(x), positions, cfg.rope_theta)
+        k = rope(proj("k")(x), positions, cfg.rope_theta)
+        v = proj("v")(x)
+        attn = cfg.attention_fn or dense_causal_attention
+        out = attn(q, k, v, causal=True)
+        return nn.DenseGeneral(cfg.embed_dim, axis=(-2, -1), use_bias=False,
+                               dtype=cfg.dtype, name="o")(out)
+
+
+class MLP(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        gate = nn.Dense(cfg.mlp_dim, use_bias=False, dtype=cfg.dtype,
+                        name="gate")(x)
+        up = nn.Dense(cfg.mlp_dim, use_bias=False, dtype=cfg.dtype,
+                      name="up")(x)
+        return nn.Dense(cfg.embed_dim, use_bias=False, dtype=cfg.dtype,
+                        name="down")(nn.silu(gate) * up)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        y = nn.RMSNorm(dtype=cfg.dtype, name="attn_norm")(x)
+        x = x + Attention(cfg, name="attn")(y, positions)
+        y = nn.RMSNorm(dtype=cfg.dtype, name="mlp_norm")(x)
+        return x + MLP(cfg, name="mlp")(y)
+
+
+class Transformer(nn.Module):
+    """Token ids [B, S] → logits [B, S, vocab].
+
+    ``position_offset`` shifts positions for sequence-parallel shards so each
+    shard computes RoPE/causal masks at its global coordinates.
+    """
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens, position_offset=0):
+        cfg = self.cfg
+        x = nn.Embed(cfg.vocab_size, cfg.embed_dim, dtype=cfg.dtype,
+                     name="embed")(tokens)
+        positions = (jnp.arange(tokens.shape[1])[None, :]
+                     + jnp.asarray(position_offset))
+        positions = jnp.broadcast_to(positions, tokens.shape)
+        for i in range(cfg.num_layers):
+            x = Block(cfg, name=f"layer_{i}")(x, positions)
+        x = nn.RMSNorm(dtype=cfg.dtype, name="final_norm")(x)
+        return nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+                        name="lm_head")(x.astype(jnp.float32))

@@ -67,6 +67,17 @@ def _data_width(axes: tuple[str, ...]) -> int:
     return n
 
 
+def _mesh_allreduce(x, axes: tuple[str, ...]):
+    """One in-mesh sum: flat psum on 1-D data meshes; two-level
+    ICI-scatter → DCN-reduce → ICI-gather on multi-slice (dcn, ici) meshes
+    (parallel/hierarchy.py; reference operations.cc:1025-1177 analog)."""
+    if len(axes) == 1:
+        return lax.psum(x, axes[0])
+    from horovod_tpu.parallel import hierarchy
+
+    return hierarchy.hierarchical_allreduce(x.reshape(-1), axes).reshape(x.shape)
+
+
 def _require_not_traced(name: str) -> None:
     core = jax.core
     if isinstance(jnp.zeros(()), core.Tracer):  # pragma: no cover - safety net
@@ -96,7 +107,7 @@ def allreduce(tensor, average: bool = True, name: str | None = None,
     if prescale_factor != 1.0:
         compressed = compressed * prescale_factor
     if axes is not None:
-        reduced = lax.psum(compressed, axes)
+        reduced = _mesh_allreduce(compressed, axes)
         if average:
             reduced = reduced / _data_width(axes)
     else:
@@ -118,7 +129,7 @@ def grouped_allreduce(tensors: Sequence, average: bool = True,
         denom = _data_width(axes)
         reduced = fusion.fused_apply(
             [c for c, _ in comp],
-            lambda flat: lax.psum(flat, axes), threshold_bytes)
+            lambda flat: _mesh_allreduce(flat, axes), threshold_bytes)
     else:
         _require_not_traced("grouped_allreduce")
         denom = basics.size()

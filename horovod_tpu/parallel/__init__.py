@@ -1,0 +1,19 @@
+"""Parallelism strategies beyond plain data-parallel.
+
+The reference's only strategy is DP plus a 2-level hierarchical allreduce
+(SURVEY §2.9); this package carries the hierarchical scheme over
+(hierarchy.py) and adds the long-context strategies the task brief makes
+first-class: ring attention (ring_attention.py) and Ulysses-style all-to-all
+sequence parallelism (ulysses.py), both pure shard_map/ppermute/all_to_all
+programs over the global mesh.
+"""
+
+from horovod_tpu.parallel.hierarchy import hierarchical_allreduce  # noqa: F401
+from horovod_tpu.parallel.ring_attention import (  # noqa: F401
+    ring_attention,
+    make_ring_attention,
+)
+from horovod_tpu.parallel.ulysses import (  # noqa: F401
+    ulysses_attention,
+    make_ulysses_attention,
+)

@@ -1,0 +1,65 @@
+"""Hierarchical (two-level) allreduce — ICI within a slice, DCN between.
+
+The reference's ``HOROVOD_HIERARCHICAL_ALLREDUCE`` path (reference
+horovod/common/operations.cc:1025-1177) is: NCCL ReduceScatter intra-node →
+per-local-rank MPI_Allreduce across nodes → NCCL AllGather intra-node, with
+the fused buffer padded so it divides evenly (operations.cc:1033-1039).
+
+The TPU translation over a ``(dcn, ici)`` mesh (mesh.py builds it for
+multi-slice jobs) is the same algebra with XLA collectives:
+
+    psum_scatter over "ici"   (each chip owns 1/chips_per_slice of the sum)
+    psum         over "dcn"   (cross-slice reduction of the small shard)
+    all_gather   over "ici"   (redistribute the full reduced buffer)
+
+This sends ``1/chips_per_slice`` of the bytes over DCN that a flat psum
+would, which is the entire point: DCN bandwidth is an order of magnitude
+below ICI.  XLA emits exactly these three collectives; on a single-slice
+(1-D) mesh we fall back to one psum.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu import mesh
+from horovod_tpu.utils import env
+
+
+def hierarchical_allreduce(flat, axes: tuple[str, ...] | None = None):
+    """Allreduce a flat (1-D) buffer over the data axes hierarchically.
+
+    ``flat`` must be 1-D with length divisible by the ici-axis size (the
+    fusion planner pads buckets to FUSION_BUFFER_ATOMIC_UNIT=128 elements,
+    which covers every slice size up to 128 chips — the analog of the
+    reference's local_size×64 padding, operations.cc:1033-1039).
+    """
+    axes = axes or mesh.data_axes()
+    if len(axes) == 1:
+        return lax.psum(flat, axes[0])
+    dcn, ici = axes
+    ici_size = lax.axis_size(ici)
+    n = flat.shape[0]
+    if n % ici_size:
+        pad = ici_size - n % ici_size
+        scattered = lax.psum_scatter(
+            jnp.pad(flat, (0, pad)), ici, tiled=True)
+    else:
+        pad = 0
+        scattered = lax.psum_scatter(flat, ici, tiled=True)
+    reduced = lax.psum(scattered, dcn)
+    out = lax.all_gather(reduced, ici, tiled=True)
+    return out[:n] if pad else out
+
+
+def data_allreduce(flat):
+    """The collective the fusion engine uses for one flat bucket: flat psum on
+    1-D meshes; hierarchical on multi-slice meshes (always beneficial there,
+    and also selectable via HOROVOD_HIERARCHICAL_ALLREDUCE for parity with
+    the reference's opt-in knob)."""
+    axes = mesh.data_axes()
+    if len(axes) > 1:
+        return hierarchical_allreduce(flat, axes)
+    _ = env.hierarchical_allreduce()  # knob read for parity; 1-D has no tiers
+    return lax.psum(flat, axes[0])

@@ -1,0 +1,94 @@
+"""Ring attention — sequence-parallel exact attention over the ICI ring.
+
+Not in the reference (it predates the technique; SURVEY §2.9) but first-class
+here: long sequences are sharded across a mesh axis, each chip keeps its
+query block resident, and key/value blocks rotate around the ring via
+``lax.ppermute`` while a flash-style online softmax accumulates the exact
+result.  Peak memory per chip is O(S/n) and the K/V transfer for step i+1
+overlaps the block matmul for step i (XLA schedules the ppermute
+asynchronously on ICI) — the TPU-native form of ring attention
+(Liu et al. 2023) built from the same collective vocabulary as the data
+plane.
+
+Numerics: logits and softmax statistics in float32, block matmuls in the
+input dtype (bf16 on the MXU); fully-masked blocks are handled by masking
+probabilities (not just logits) so causal shards never divide by zero.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, q_pos, k_pos, causal, m, l, acc):
+    """One online-softmax accumulation step.
+
+    q: [B, Sq, H, D]; k, v: [B, Sk, H, D]; positions: [Sq]/[Sk] globals;
+    m, l: [B, H, Sq]; acc: [B, H, Sq, D] (f32).
+    """
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        mask = q_pos[:, None] >= k_pos[None, :]          # [Sq, Sk]
+        logits = jnp.where(mask, logits, NEG_INF)
+    m_new = jnp.maximum(m, logits.max(axis=-1))
+    p = jnp.exp(logits - m_new[..., None])
+    if causal:
+        p = jnp.where(mask, p, 0.0)
+    correction = jnp.exp(m - m_new)
+    l_new = l * correction + p.sum(axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bhqd", p.astype(v.dtype), v,
+                    preferred_element_type=jnp.float32)
+    acc_new = acc * correction[..., None] + pv
+    return m_new, l_new, acc_new
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = True):
+    """Exact attention over a sequence sharded on ``axis_name``.
+
+    Shapes: [B, S_local, H, D] per chip; global sequence = n × S_local in
+    ring order (shard i holds positions [i·S_local, (i+1)·S_local)).  Returns
+    the local output shard, same shape/dtype as ``q``.
+    """
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+    q_pos = my * s_local + jnp.arange(s_local)
+    # Accumulators start device-invariant but become device-varying inside the
+    # scan; mark them varying over the ring axis up front (shard_map vma rule).
+    varying = functools.partial(lax.pcast, axis_name=axis_name, to="varying")
+    m = varying(jnp.full((b, h, s_local), NEG_INF, jnp.float32))
+    l = varying(jnp.zeros((b, h, s_local), jnp.float32))
+    acc = varying(jnp.zeros((b, h, s_local, d), jnp.float32))
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, i):
+        k, v, m, l, acc = carry
+        # After i forward rotations this chip holds the block that originated
+        # at ring neighbour (my - i) mod n.
+        owner = (my - i) % n
+        k_pos = owner * s_local + jnp.arange(s_local)
+        m, l, acc = _block_attend(q, k, v, q_pos, k_pos, causal, m, l, acc)
+        # Rotate K/V for the next step; XLA overlaps this ICI transfer with
+        # the next block's matmuls (the send is not data-dependent on them).
+        k = lax.ppermute(k, axis_name, perm)
+        v = lax.ppermute(v, axis_name, perm)
+        return (k, v, m, l, acc), None
+
+    (_, _, m, l, acc), _ = lax.scan(step, (k, v, m, l, acc), jnp.arange(n))
+    # Guard l==0 (a causal top-left shard attending nothing can't occur —
+    # every query sees at least itself — but keep the division total).
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def make_ring_attention(axis_name: str):
+    """Adapter producing a ``TransformerConfig.attention_fn``."""
+    return functools.partial(ring_attention, axis_name=axis_name)

@@ -1,0 +1,50 @@
+"""Ulysses-style sequence parallelism — all-to-all head/sequence swap.
+
+The second long-context strategy from the task brief (DeepSpeed-Ulysses
+pattern): instead of rotating K/V blocks (ring_attention.py), one
+``lax.all_to_all`` re-partitions [B, S/n, H, D] → [B, S, H/n, D] so each chip
+runs *dense* attention over the full sequence for its head group, then a
+second all-to-all restores sequence sharding.  Two all-to-alls move
+O(B·S·H·D/n) bytes each on ICI; attention itself is the unmodified dense
+kernel, so this composes with any attention implementation (including a
+pallas flash kernel) — the trade against ring attention is full-sequence
+activation memory per chip vs head-divisibility (H must be divisible by n).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax import lax
+
+from horovod_tpu.models.transformer import dense_causal_attention
+
+
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = True,
+                      inner=dense_causal_attention):
+    """Exact attention over a sequence sharded on ``axis_name``.
+
+    Shapes: [B, S_local, H, D] per chip, H divisible by the axis size.
+    """
+    n = lax.axis_size(axis_name)
+    h = q.shape[2]
+    if h % n:
+        raise ValueError(
+            f"ulysses_attention requires heads ({h}) divisible by the "
+            f"sequence-parallel axis size ({n}); use ring_attention instead.")
+    # [B, S/n, H, D] -> [B, S, H/n, D]: split heads across the axis, gather
+    # the sequence dimension.  tiled=True keeps dims merged (no new axis).
+    to_heads = functools.partial(lax.all_to_all, axis_name=axis_name,
+                                 split_axis=2, concat_axis=1, tiled=True)
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    out = inner(qh, kh, vh, causal=causal)
+    # [B, S, H/n, D] -> [B, S/n, H, D]
+    return lax.all_to_all(out, axis_name=axis_name, split_axis=1,
+                          concat_axis=2, tiled=True)
+
+
+def make_ulysses_attention(axis_name: str, inner=dense_causal_attention):
+    """Adapter producing a ``TransformerConfig.attention_fn``."""
+    return functools.partial(ulysses_attention, axis_name=axis_name,
+                             inner=inner)

@@ -1,0 +1,71 @@
+"""Callback tests — mirrors reference keras callback behaviours
+(keras/callbacks_impl.py; tested by reference test_keras.py)."""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+
+@dataclasses.dataclass
+class FakeState:
+    params: dict
+    opt_state: object = None
+
+    def replace(self, **kw):
+        return dataclasses.replace(self, **kw)
+
+
+def test_metric_average_single_process(hvd):
+    cb = hvd.callbacks.MetricAverageCallback()
+    logs = {"loss": 2.0, "acc": np.float32(0.5), "name": "skip-me"}
+    cb.on_epoch_end(0, None, logs)
+    assert logs["loss"] == pytest.approx(2.0)
+    assert logs["acc"] == pytest.approx(0.5)
+    assert logs["name"] == "skip-me"
+
+
+def test_warmup_callback_ramp(hvd):
+    n = hvd.num_chips()
+    cb = hvd.callbacks.LearningRateWarmupCallback(
+        0.1, warmup_epochs=5, steps_per_epoch=10)
+    state = FakeState(params={})
+    cb.on_epoch_begin(0, state)
+    cb.on_batch_begin(0, state)
+    assert cb.lr() == pytest.approx(0.1)  # epoch 0 batch 0: 1x
+    cb.on_epoch_begin(5, state)
+    cb.on_batch_begin(0, state)
+    assert cb.lr() == pytest.approx(0.1 * n)  # fully warmed to size x
+
+
+def test_schedule_callback_staircase(hvd):
+    cb = hvd.callbacks.LearningRateScheduleCallback(
+        1.0, multiplier=lambda e: 0.1 ** (e // 2), start_epoch=0)
+    state = FakeState(params={})
+    cb.on_epoch_begin(0, state)
+    assert cb.lr() == pytest.approx(1.0)
+    cb.on_epoch_begin(2, state)
+    assert cb.lr() == pytest.approx(0.1)
+    # momentum correction factor reflects the LR jump
+    assert cb.momentum_correction_factor() == pytest.approx(0.1)
+
+
+def test_momentum_correction_applies_to_trace(hvd):
+    opt = optax.sgd(0.1, momentum=0.9)
+    params = {"w": jnp.ones((4,))}
+    state = opt.init(params)
+    updates, state = opt.update({"w": jnp.ones((4,))}, state, params)
+    fixed = hvd.callbacks.apply_momentum_correction(state, 0.5)
+    trace_before = state[0].trace["w"]
+    trace_after = fixed[0].trace["w"]
+    np.testing.assert_allclose(trace_after, trace_before * 0.5, rtol=1e-6)
+
+
+def test_broadcast_callback(hvd):
+    state = FakeState(params={"w": jnp.ones((2,))},
+                      opt_state=optax.sgd(0.1).init({"w": jnp.ones((2,))}))
+    cb = hvd.callbacks.BroadcastGlobalVariablesCallback(0)
+    out = cb.on_train_begin(state)
+    np.testing.assert_array_equal(out.params["w"], state.params["w"])
