@@ -1,0 +1,108 @@
+"""Benchmark driver — ResNet-50 synthetic throughput (img/sec/chip).
+
+Reproduces the reference's in-tree harness semantics (reference
+examples/pytorch_synthetic_benchmark.py:14-107): synthetic ImageNet-shaped
+data, full training step (forward + backward + DistributedOptimizer update),
+10 warmup batches, then 10 timed iterations of 10 batches each, reporting
+mean images/sec.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "img/s/chip", "vs_baseline": N}
+
+``vs_baseline`` divides by the only per-device figure the reference publishes
+(docs/benchmarks.md:34-38: ResNet-101, 1656.82 img/s on 16 Pascal GPUs
+= 103.55 img/s/GPU; hardware era differs — the ratio is recorded for trend
+tracking, not as a same-silicon comparison).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+BASELINE_IMG_PER_SEC_PER_DEVICE = 1656.82 / 16  # reference docs/benchmarks.md:34-38
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models import ResNet50
+
+    hvd.init()
+    batch = int(os.environ.get("BENCH_BATCH", "128"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "10"))
+    iters = int(os.environ.get("BENCH_ITERS", "10"))
+    batches_per_iter = int(os.environ.get("BENCH_BATCHES_PER_ITER", "10"))
+
+    n_chips = hvd.num_chips()
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(rng, (batch * n_chips, 224, 224, 3), jnp.float32)
+    y = jax.random.randint(rng, (batch * n_chips,), 0, 1000)
+    variables = model.init(rng, x[:2], train=True)
+    params = variables["params"]
+    batch_stats = variables["batch_stats"]
+    opt = hvd.DistributedOptimizer(optax.sgd(0.01, momentum=0.9),
+                                   compression=hvd.Compression.none)
+    opt_state = opt.init(params)
+
+    spec = hvd.batch_spec(4)
+    label_spec = hvd.batch_spec(1)
+
+    def train_step(params, batch_stats, opt_state, x, y):
+        def loss_fn(p):
+            logits, mutated = model.apply(
+                {"params": p, "batch_stats": batch_stats}, x, train=True,
+                mutable=["batch_stats"])
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean(), mutated["batch_stats"]
+
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_stats, opt_state, loss
+
+    step = jax.jit(hvd.shard(
+        train_step,
+        in_specs=(P(), P(), P(), spec, label_spec),
+        out_specs=(P(), P(), P(), P())),
+        donate_argnums=(0, 1, 2))
+
+    def run_one():
+        nonlocal params, batch_stats, opt_state
+        params, batch_stats, opt_state, loss = step(
+            params, batch_stats, opt_state, x, y)
+        return loss
+
+    for _ in range(warmup):
+        loss = run_one()
+    jax.block_until_ready(loss)
+
+    rates = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        for _ in range(batches_per_iter):
+            loss = run_one()
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+        rates.append(batch * n_chips * batches_per_iter / dt)
+
+    total = float(np.mean(rates))
+    per_chip = total / n_chips
+    print(json.dumps({
+        "metric": "resnet50_synthetic_train_throughput",
+        "value": round(per_chip, 2),
+        "unit": "img/s/chip",
+        "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC_PER_DEVICE, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

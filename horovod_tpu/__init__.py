@@ -44,13 +44,18 @@ from horovod_tpu.mesh import (  # noqa: F401
 from horovod_tpu.ops import (  # noqa: F401
     Compression,
     allgather,
+    allgather_async,
     allreduce,
+    allreduce_async,
     allreduce_sparse,
     batch_spec,
     broadcast,
+    broadcast_async,
     grouped_allreduce,
+    poll,
     shard,
     sparse_to_dense,
+    synchronize,
 )
 from horovod_tpu.training import (  # noqa: F401
     DistributedOptimizer,

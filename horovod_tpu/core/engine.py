@@ -1,17 +1,345 @@
-"""Python driver for the native coordination engine (see core/src/).
+"""Python driver for the native coordination engine (core/src/).
 
-Full async-handle machinery lands with the C++ core; this module always
-exposes ``shutdown_engine`` so ``basics.shutdown`` can tear down whatever is
-running (analog of reference operations.cc:1947-1985).
+Architecture (the reference's L1–L3 stack, re-plumbed for TPU):
+
+* ``libhvdcore.so`` (C++) owns the background cycle thread, cross-process
+  readiness negotiation over loopback/TCP, fusion scheduling, the stall
+  checker and the Chrome-tracing timeline — the rebuild of reference
+  horovod/common/operations.cc.
+* This module is the ctypes shim (the analog of the reference's
+  ``HorovodBasics`` ctypes layer, common/__init__.py:51-154, and of the
+  torch ``handle_manager`` surface, torch/handle_manager.{h,cc}).
+* An **executor thread** polls the engine for fused ExecBatches and runs the
+  actual collective as JAX host-level operations (process_allgather /
+  broadcast), then reports completion.  In the reference the background
+  thread did MPI/NCCL itself (operations.cc:714-1362); here the native side
+  schedules and Python/XLA moves the bytes.
+
+The engine powers the *dynamic/eager* API — ``allreduce_async`` + handles +
+the torch binding — where op order across hosts is not statically known.
+The compiled SPMD path (ops/collective_ops.py) never touches it.
 """
 
 from __future__ import annotations
 
-_engine = None
+import ctypes
+import os
+import struct
+import subprocess
+import threading
+from typing import Callable
+
+import numpy as np
+
+from horovod_tpu.utils import env
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_HERE, "libhvdcore.so")
+
+# Wire enums — must match core/src/common.h and message.h.
+OP_ALLREDUCE, OP_ALLGATHER, OP_BROADCAST, OP_ALLTOALL, OP_BARRIER = range(5)
+RESP_ERROR = 5
+
+STATUS_OK = 0
+STATUS_UNKNOWN = 1
+STATUS_PRECONDITION = 2
+STATUS_ABORTED = 3
+STATUS_INVALID = 4
+STATUS_IN_PROGRESS = 5
+
+DTYPES: dict[str, int] = {
+    "uint8": 0, "int8": 1, "int32": 2, "int64": 3, "float16": 4,
+    "float32": 5, "float64": 6, "bool": 7, "bfloat16": 8,
+}
+DTYPE_NAMES = {v: k for k, v in DTYPES.items()}
+
+
+class CollectiveError(RuntimeError):
+    """Coordinated error delivered to every rank (reference
+    MPIResponse::ERROR → FailedPreconditionError, operations.cc:494-499)."""
+
+
+def _build_library() -> None:
+    subprocess.run(["make", "-C", _HERE, "-j4"], check=True,
+                   capture_output=True)
+
+
+def _load_library() -> ctypes.CDLL:
+    if not os.path.exists(_LIB_PATH):
+        _build_library()
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.hvd_create.restype = ctypes.c_void_p
+    lib.hvd_create.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_double, ctypes.c_longlong,
+        ctypes.c_double, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_int]
+    lib.hvd_start.restype = ctypes.c_int
+    lib.hvd_start.argtypes = [ctypes.c_void_p,
+                              ctypes.POINTER(ctypes.c_int),
+                              ctypes.c_char_p, ctypes.c_int]
+    lib.hvd_shutdown.argtypes = [ctypes.c_void_p]
+    lib.hvd_destroy.argtypes = [ctypes.c_void_p]
+    lib.hvd_enqueue.restype = ctypes.c_longlong
+    lib.hvd_enqueue.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_int,
+        ctypes.c_char_p, ctypes.c_int]
+    lib.hvd_next_batch.restype = ctypes.c_int
+    lib.hvd_next_batch.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_int, ctypes.c_double]
+    lib.hvd_batch_done.argtypes = [ctypes.c_void_p, ctypes.c_longlong,
+                                   ctypes.c_int, ctypes.c_char_p]
+    lib.hvd_poll.restype = ctypes.c_int
+    lib.hvd_poll.argtypes = [ctypes.c_void_p, ctypes.c_longlong]
+    lib.hvd_wait.restype = ctypes.c_int
+    lib.hvd_wait.argtypes = [ctypes.c_void_p, ctypes.c_longlong,
+                             ctypes.c_double]
+    lib.hvd_handle_status.restype = ctypes.c_int
+    lib.hvd_handle_status.argtypes = [ctypes.c_void_p, ctypes.c_longlong,
+                                      ctypes.c_char_p, ctypes.c_int]
+    lib.hvd_release.restype = ctypes.c_int
+    lib.hvd_release.argtypes = [ctypes.c_void_p, ctypes.c_longlong,
+                                ctypes.c_char_p, ctypes.c_int]
+    for name in ("hvd_half_to_float", "hvd_float_to_half",
+                 "hvd_bf16_to_float", "hvd_float_to_bf16"):
+        fn = getattr(lib, name)
+        fn.restype = None
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_longlong]
+    return lib
+
+
+_lib: ctypes.CDLL | None = None
+_lib_lock = threading.Lock()
+
+
+def lib() -> ctypes.CDLL:
+    global _lib
+    with _lib_lock:
+        if _lib is None:
+            _lib = _load_library()
+        return _lib
+
+
+class ExecBatch:
+    """Parsed fused batch from hvd_next_batch (wire layout in c_api.cc)."""
+
+    __slots__ = ("id", "type", "dtype", "root_rank", "names", "handles",
+                 "shapes", "first_dim_sizes")
+
+    def __init__(self, raw: bytes):
+        off = 0
+
+        def i32():
+            nonlocal off
+            v = struct.unpack_from("<i", raw, off)[0]
+            off += 4
+            return v
+
+        def i64():
+            nonlocal off
+            v = struct.unpack_from("<q", raw, off)[0]
+            off += 8
+            return v
+
+        def u8():
+            nonlocal off
+            v = raw[off]
+            off += 1
+            return v
+
+        def s():
+            nonlocal off
+            n = i32()
+            v = raw[off:off + n].decode()
+            off += n
+            return v
+
+        self.id = i64()
+        self.type = u8()
+        self.dtype = u8()
+        self.root_rank = i32()
+        n = i32()
+        self.names, self.handles, self.shapes = [], [], []
+        for _ in range(n):
+            self.names.append(s())
+            self.handles.append(i64())
+            nd = i32()
+            self.shapes.append(tuple(i64() for _ in range(nd)))
+        ns = i32()
+        self.first_dim_sizes = [i64() for _ in range(ns)]
+
+
+class NativeEngine:
+    """One per process; wraps the C++ engine + the executor thread."""
+
+    def __init__(self, rank: int, size: int, *,
+                 executor: Callable[["NativeEngine", ExecBatch], None] | None = None,
+                 coordinator_host: str | None = None,
+                 coordinator_port: int = 0,
+                 cycle_time_ms: float | None = None):
+        self.rank = rank
+        self.size = size
+        self._lib = lib()
+        self._store: dict[str, np.ndarray] = {}
+        self._results: dict[int, np.ndarray] = {}
+        self._handle_names: dict[int, str] = {}
+        self._store_lock = threading.Lock()
+        self._shutdown = threading.Event()
+        from horovod_tpu.core import executors
+
+        self._executor = executor or executors.default_executor(rank, size)
+        tl = env.timeline_path()
+        self._ptr = self._lib.hvd_create(
+            rank, size,
+            cycle_time_ms if cycle_time_ms is not None else env.cycle_time_ms(),
+            env.fusion_threshold_bytes(),
+            env.stall_warning_seconds(),
+            0 if env.stall_check_disabled() else 1,
+            tl.encode() if tl and rank == 0 else None,
+            (coordinator_host or "127.0.0.1").encode(),
+            coordinator_port)
+        err = ctypes.create_string_buffer(512)
+        port = ctypes.c_int(0)
+        rc = self._lib.hvd_start(self._ptr, ctypes.byref(port), err, 512)
+        if rc != 0:
+            raise RuntimeError(f"engine start failed: {err.value.decode()}")
+        self.bound_port = port.value
+        self._exec_thread = threading.Thread(
+            target=self._exec_loop, name="hvd-executor", daemon=True)
+        self._exec_thread.start()
+
+    # -- client API ---------------------------------------------------------
+
+    def enqueue(self, name: str, array: np.ndarray, op: int,
+                root_rank: int = -1) -> int:
+        """Announce a tensor; returns an async handle (reference
+        EnqueueTensorAllreduce, operations.cc:2025-2061)."""
+        arr = np.ascontiguousarray(array)
+        dtype_id = DTYPES.get(arr.dtype.name)
+        if dtype_id is None:
+            raise TypeError(f"unsupported dtype {arr.dtype}")
+        dims = (ctypes.c_longlong * max(arr.ndim, 1))(*arr.shape)
+        err = ctypes.create_string_buffer(512)
+        with self._store_lock:
+            if name in self._store:
+                # Fast-path duplicate rejection; the native engine enforces
+                # the same rule for the window after execution started
+                # (reference operations.cc:2035-2040).
+                raise CollectiveError(
+                    f"Duplicate tensor name {name}; a previous request for "
+                    f"this tensor has not completed.")
+            self._store[name] = arr
+        h = self._lib.hvd_enqueue(self._ptr, name.encode(), op, dtype_id,
+                                  dims, arr.ndim, root_rank, err, 512)
+        if h < 0:
+            with self._store_lock:
+                self._store.pop(name, None)
+            raise CollectiveError(err.value.decode())
+        with self._store_lock:
+            self._handle_names[int(h)] = name
+        return int(h)
+
+    def poll(self, handle: int) -> bool:
+        return bool(self._lib.hvd_poll(self._ptr, handle))
+
+    def synchronize(self, handle: int, timeout_s: float = 300.0) -> np.ndarray:
+        """Block until done; return the result array.  Blocks on the native
+        condition variable (the reference instead polls at 1 ms,
+        torch/mpi_ops_v2.cc:228-234)."""
+        if not self._lib.hvd_wait(self._ptr, handle, timeout_s * 1000.0):
+            raise TimeoutError(f"handle {handle} did not complete "
+                               f"within {timeout_s}s")
+        err = ctypes.create_string_buffer(2048)
+        rc = self._lib.hvd_release(self._ptr, handle, err, 2048)
+        with self._store_lock:
+            result = self._results.pop(handle, None)
+            name = self._handle_names.pop(handle, None)
+            if rc != STATUS_OK and name is not None:
+                # On errors no executor ever took the input; free the name so
+                # later enqueues aren't rejected as duplicates.  (On success
+                # the executor consumed it — and the name may already belong
+                # to a newer request, which must not be disturbed.)
+                self._store.pop(name, None)
+        if rc == STATUS_PRECONDITION:
+            raise CollectiveError(err.value.decode())
+        if rc != STATUS_OK:
+            raise RuntimeError(
+                f"collective failed (status {rc}): {err.value.decode()}")
+        return result
+
+    def shutdown(self):
+        if self._shutdown.is_set():
+            return
+        self._shutdown.set()
+        self._lib.hvd_shutdown(self._ptr)
+        self._exec_thread.join(timeout=10)
+        if self._exec_thread.is_alive():
+            # Executor is stuck inside a collective; destroying the native
+            # engine now would be a use-after-free when it resumes.  Leak it
+            # (process is exiting anyway) rather than crash.
+            import warnings
+
+            warnings.warn("horovod_tpu: executor thread did not exit within "
+                          "10s; native engine leaked", RuntimeWarning)
+            return
+        self._lib.hvd_destroy(self._ptr)
+        self._ptr = None
+
+    # -- executor side ------------------------------------------------------
+
+    def _exec_loop(self):
+        buf = ctypes.create_string_buffer(1 << 20)
+        while not self._shutdown.is_set():
+            n = self._lib.hvd_next_batch(self._ptr, buf, len(buf), 100.0)
+            if n == 0:
+                continue
+            if n == -1:
+                return
+            if n < -1:
+                buf = ctypes.create_string_buffer(-n + 16)
+                continue
+            batch = ExecBatch(buf.raw[:n])
+            try:
+                self._executor(self, batch)
+                self._lib.hvd_batch_done(self._ptr, batch.id, STATUS_OK, None)
+            except Exception as e:  # noqa: BLE001 - report, don't kill thread
+                self._lib.hvd_batch_done(self._ptr, batch.id, STATUS_UNKNOWN,
+                                         str(e).encode())
+
+    def take_inputs(self, batch: ExecBatch) -> list[np.ndarray]:
+        with self._store_lock:
+            return [self._store.pop(name) for name in batch.names]
+
+    def put_results(self, batch: ExecBatch, outs: list[np.ndarray]):
+        with self._store_lock:
+            for h, out in zip(batch.handles, outs):
+                self._results[h] = out
+
+
+# -- module-level singleton management (mirrors basics._topology) -----------
+
+_engine: NativeEngine | None = None
+_engine_lock = threading.Lock()
+
+
+def get_engine() -> NativeEngine:
+    """Lazily start the engine for the current process topology."""
+    global _engine
+    with _engine_lock:
+        if _engine is None:
+            from horovod_tpu import basics
+
+            host = os.environ.get("HVD_TPU_COORDINATOR_HOST")
+            port = int(os.environ.get("HVD_TPU_COORDINATOR_PORT", "0") or 0)
+            _engine = NativeEngine(basics.rank(), basics.size(),
+                                   coordinator_host=host,
+                                   coordinator_port=port)
+        return _engine
 
 
 def shutdown_engine() -> None:
     global _engine
-    if _engine is not None:
-        _engine.shutdown()
-        _engine = None
+    with _engine_lock:
+        if _engine is not None:
+            _engine.shutdown()
+            _engine = None

@@ -1,0 +1,86 @@
+"""Batch executors — the data-plane half of the eager path.
+
+The native engine negotiates and fuses (core/src/engine.cc); an executor
+moves the bytes for one ExecBatch.  This split replaces the body of the
+reference's ``PerformOperation`` (reference operations.cc:714-1362): where
+the reference memcpys into a fusion buffer and calls MPI/NCCL, we
+concatenate numpy views and run a process-level JAX collective.
+
+Executors:
+
+* ``local``    — single-process jobs (the common TPU case): collectives over
+  one process are identities; fusion/ordering/handles still exercise the
+  full native path.
+* ``multihost`` — multi-process jobs: flat fused buffer through
+  ``jax.experimental.multihost_utils`` (allgather+sum = allreduce), riding
+  DCN/ICI via the jax.distributed client.  Requires identical batch order on
+  every process — exactly what the coordinator guarantees.
+
+Select with ``HVD_TPU_EXECUTOR`` (local|multihost); default picks by size.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def default_executor(rank: int, size: int):
+    choice = os.environ.get("HVD_TPU_EXECUTOR")
+    if choice == "local" or (choice is None and size == 1):
+        return local_executor
+    if choice in (None, "multihost"):
+        return multihost_executor
+    raise ValueError(f"unknown HVD_TPU_EXECUTOR={choice}")
+
+
+def local_executor(engine, batch) -> None:
+    """Single-process semantics: sum/gather/broadcast over one contributor."""
+    inputs = engine.take_inputs(batch)
+    engine.put_results(batch, inputs)
+
+
+def multihost_executor(engine, batch) -> None:
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+
+    from horovod_tpu.core import engine as engine_mod
+
+    inputs = engine.take_inputs(batch)
+    size = engine.size
+
+    if batch.type == engine_mod.OP_ALLREDUCE:
+        # Fused flat buffer, one collective (reference fusion semantics,
+        # operations.cc:969-1258).
+        flat = np.concatenate([a.ravel() for a in inputs])
+        gathered = multihost_utils.process_allgather(
+            jnp.asarray(flat)[None], tiled=False)
+        summed = np.asarray(gathered.reshape(size, -1).sum(axis=0),
+                            dtype=flat.dtype)
+        outs = []
+        off = 0
+        for a in inputs:
+            outs.append(summed[off:off + a.size].reshape(a.shape))
+            off += a.size
+        engine.put_results(batch, outs)
+    elif batch.type == engine_mod.OP_ALLGATHER:
+        # Ragged dim-0 gather using the negotiated per-rank sizes
+        # (reference MPI_Allgatherv path, operations.cc:1273-1332).
+        a = inputs[0]
+        sizes = batch.first_dim_sizes
+        max_d = max(sizes) if sizes else a.shape[0]
+        pad = [(0, max_d - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
+        padded = np.pad(a, pad)
+        gathered = np.asarray(multihost_utils.process_allgather(
+            jnp.asarray(padded)[None], tiled=False))
+        gathered = gathered.reshape((size, max_d) + a.shape[1:])
+        pieces = [gathered[r, : sizes[r]] for r in range(size)]
+        engine.put_results(batch, [np.concatenate(pieces, axis=0)])
+    elif batch.type == engine_mod.OP_BROADCAST:
+        a = inputs[0]
+        out = np.asarray(multihost_utils.broadcast_one_to_all(
+            jnp.asarray(a), is_source=engine.rank == batch.root_rank))
+        engine.put_results(batch, [out])
+    else:
+        raise NotImplementedError(f"batch type {batch.type}")

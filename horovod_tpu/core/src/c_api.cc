@@ -1,0 +1,159 @@
+// C ABI for the Python binding (ctypes).
+//
+// The analog of the reference's C API surface (reference
+// horovod/common/operations.h:68-118 + the per-framework shims); loaded by
+// horovod_tpu/core/engine.py with ctypes instead of a pybind11 module (the
+// image has no pybind11; the surface is small and stable enough for a plain
+// C ABI).
+#include <cstring>
+#include <string>
+
+#include "engine.h"
+#include "half.h"
+
+using hvd::DataType;
+using hvd::Engine;
+using hvd::EngineOptions;
+using hvd::ExecBatch;
+using hvd::OpType;
+using hvd::Status;
+using hvd::TensorShape;
+
+namespace {
+
+void CopyErr(const std::string& msg, char* err, int errlen) {
+  if (err == nullptr || errlen <= 0) return;
+  std::snprintf(err, static_cast<size_t>(errlen), "%s", msg.c_str());
+}
+
+struct Writer {
+  std::string buf;
+  void u8(uint8_t v) { buf.push_back(static_cast<char>(v)); }
+  void i32(int32_t v) { buf.append(reinterpret_cast<char*>(&v), 4); }
+  void i64(int64_t v) { buf.append(reinterpret_cast<char*>(&v), 8); }
+  void str(const std::string& s) {
+    i32(static_cast<int32_t>(s.size()));
+    buf.append(s);
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* hvd_create(int rank, int size, double cycle_ms,
+                 long long fusion_threshold, double stall_seconds,
+                 int stall_check, const char* timeline_path,
+                 const char* coord_host, int coord_port) {
+  EngineOptions opts;
+  opts.rank = rank;
+  opts.size = size;
+  opts.cycle_time_ms = cycle_ms;
+  opts.fusion_threshold_bytes = fusion_threshold;
+  opts.stall_warning_seconds = stall_seconds;
+  opts.stall_check = stall_check != 0;
+  if (timeline_path != nullptr) opts.timeline_path = timeline_path;
+  if (coord_host != nullptr) opts.coordinator_host = coord_host;
+  opts.coordinator_port = coord_port;
+  return new Engine(std::move(opts));
+}
+
+int hvd_start(void* e, int* bound_port, char* err, int errlen) {
+  Status s = static_cast<Engine*>(e)->Start(bound_port);
+  if (!s.ok()) {
+    CopyErr(s.reason, err, errlen);
+    return static_cast<int>(s.type);
+  }
+  return 0;
+}
+
+void hvd_shutdown(void* e) { static_cast<Engine*>(e)->Shutdown(); }
+
+void hvd_destroy(void* e) { delete static_cast<Engine*>(e); }
+
+long long hvd_enqueue(void* e, const char* name, int op, int dtype,
+                      const long long* dims, int ndims, int root_rank,
+                      char* err, int errlen) {
+  TensorShape shape;
+  shape.dims.assign(dims, dims + ndims);
+  Status s;
+  int64_t h = static_cast<Engine*>(e)->Enqueue(
+      name, static_cast<OpType>(op), static_cast<DataType>(dtype), shape,
+      root_rank, &s);
+  if (h < 0) CopyErr(s.reason, err, errlen);
+  return h;
+}
+
+// Returns >0 (bytes written), 0 (timeout), -1 (engine stopped), or
+// -needed-1 when buflen is too small (caller retries with a larger buffer).
+int hvd_next_batch(void* e, char* buf, int buflen, double timeout_ms) {
+  ExecBatch b;
+  int r = static_cast<Engine*>(e)->NextBatch(&b, timeout_ms);
+  if (r <= 0) return r;
+  Writer w;
+  w.i64(b.id);
+  w.u8(static_cast<uint8_t>(b.type));
+  w.u8(static_cast<uint8_t>(b.dtype));
+  w.i32(b.root_rank);
+  w.i32(static_cast<int32_t>(b.names.size()));
+  for (size_t i = 0; i < b.names.size(); ++i) {
+    w.str(b.names[i]);
+    w.i64(b.handles[i]);
+    w.i32(static_cast<int32_t>(b.shapes[i].dims.size()));
+    for (auto d : b.shapes[i].dims) w.i64(d);
+  }
+  w.i32(static_cast<int32_t>(b.first_dim_sizes.size()));
+  for (auto d : b.first_dim_sizes) w.i64(d);
+  if (static_cast<int>(w.buf.size()) > buflen) {
+    // Put the batch back; the caller grows its buffer to -ret-1 and retries.
+    int needed = static_cast<int>(w.buf.size());
+    static_cast<Engine*>(e)->RequeueBatch(std::move(b));
+    return -needed - 1;
+  }
+  std::memcpy(buf, w.buf.data(), w.buf.size());
+  return static_cast<int>(w.buf.size());
+}
+
+void hvd_batch_done(void* e, long long batch_id, int status,
+                    const char* reason) {
+  Status s;
+  s.type = static_cast<hvd::StatusType>(status);
+  if (reason != nullptr) s.reason = reason;
+  static_cast<Engine*>(e)->BatchDone(batch_id, s);
+}
+
+int hvd_poll(void* e, long long handle) {
+  return static_cast<Engine*>(e)->PollHandle(handle) ? 1 : 0;
+}
+
+int hvd_wait(void* e, long long handle, double timeout_ms) {
+  return static_cast<Engine*>(e)->WaitHandle(handle, timeout_ms) ? 1 : 0;
+}
+
+int hvd_handle_status(void* e, long long handle, char* reason, int rlen) {
+  Status s = static_cast<Engine*>(e)->PeekHandle(handle);
+  CopyErr(s.reason, reason, rlen);
+  return static_cast<int>(s.type);
+}
+
+int hvd_release(void* e, long long handle, char* reason, int rlen) {
+  Status s = static_cast<Engine*>(e)->ReleaseHandle(handle);
+  CopyErr(s.reason, reason, rlen);
+  return static_cast<int>(s.type);
+}
+
+// fp16/bf16 host converters (half.h) for the torch/numpy staging paths.
+void hvd_half_to_float(const unsigned short* src, float* dst, long long n) {
+  hvd::HalfToFloat(src, dst, static_cast<size_t>(n));
+}
+void hvd_float_to_half(const float* src, unsigned short* dst, long long n) {
+  hvd::FloatToHalf(src, dst, static_cast<size_t>(n));
+}
+void hvd_bf16_to_float(const unsigned short* src, float* dst, long long n) {
+  hvd::BFloat16ToFloat(src, dst, static_cast<size_t>(n));
+}
+void hvd_float_to_bf16(const float* src, unsigned short* dst, long long n) {
+  hvd::FloatToBFloat16(src, dst, static_cast<size_t>(n));
+}
+
+}  // extern "C"

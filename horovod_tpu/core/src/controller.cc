@@ -1,0 +1,353 @@
+#include "controller.h"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cerrno>
+#include <cstring>
+#include <sstream>
+
+namespace hvd {
+
+// ---------------------------------------------------------------------------
+// TCP framing helpers
+// ---------------------------------------------------------------------------
+
+namespace {
+
+bool SendAll(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (w < 0 && errno == EINTR) continue;
+    if (w <= 0) return false;
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool RecvAll(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r < 0 && errno == EINTR) continue;
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool SendFrame(int fd, const std::string& payload) {
+  uint32_t len = static_cast<uint32_t>(payload.size());
+  return SendAll(fd, &len, 4) && SendAll(fd, payload.data(), payload.size());
+}
+
+bool RecvFrame(int fd, std::string* payload) {
+  uint32_t len = 0;
+  if (!RecvAll(fd, &len, 4)) return false;
+  if (len > (64u << 20)) return false;  // 64 MiB sanity cap
+  payload->resize(len);
+  return len == 0 || RecvAll(fd, payload->data(), len);
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// TcpControlPlane
+// ---------------------------------------------------------------------------
+
+std::unique_ptr<TcpControlPlane> TcpControlPlane::MakeCoordinator(
+    int port, int size, std::string* err) {
+  std::unique_ptr<TcpControlPlane> cp(new TcpControlPlane());
+  cp->coordinator_ = true;
+  cp->listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (cp->listen_fd_ < 0) {
+    *err = "socket() failed";
+    return nullptr;
+  }
+  int one = 1;
+  ::setsockopt(cp->listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(cp->listen_fd_, reinterpret_cast<sockaddr*>(&addr),
+             sizeof(addr)) != 0 ||
+      ::listen(cp->listen_fd_, size) != 0) {
+    *err = "bind/listen failed on port " + std::to_string(port);
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(cp->listen_fd_, reinterpret_cast<sockaddr*>(&addr), &alen);
+  cp->port_ = ntohs(addr.sin_port);
+  cp->worker_fds_.assign(static_cast<size_t>(size > 0 ? size - 1 : 0), -1);
+  for (int i = 0; i < size - 1; ++i) {
+    int fd = ::accept(cp->listen_fd_, nullptr, nullptr);
+    if (fd < 0) {
+      *err = "accept() failed";
+      return nullptr;
+    }
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    std::string hello;
+    int32_t rank = -1;
+    if (!RecvFrame(fd, &hello) || hello.size() != 4) {
+      *err = "bad hello";
+      return nullptr;
+    }
+    std::memcpy(&rank, hello.data(), 4);
+    if (rank < 1 || rank >= size || cp->worker_fds_[rank - 1] != -1) {
+      *err = "bad hello rank " + std::to_string(rank);
+      return nullptr;
+    }
+    cp->worker_fds_[rank - 1] = fd;
+  }
+  return cp;
+}
+
+std::unique_ptr<TcpControlPlane> TcpControlPlane::MakeWorker(
+    const std::string& host, int port, int rank, std::string* err) {
+  std::unique_ptr<TcpControlPlane> cp(new TcpControlPlane());
+  cp->coordinator_ = false;
+  cp->sock_ = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (cp->sock_ < 0) {
+    *err = "socket() failed";
+    return nullptr;
+  }
+  int one = 1;
+  ::setsockopt(cp->sock_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+    *err = "bad coordinator address " + host;
+    return nullptr;
+  }
+  // The coordinator may come up after workers; retry for ~30 s.
+  for (int attempt = 0;; ++attempt) {
+    if (::connect(cp->sock_, reinterpret_cast<sockaddr*>(&addr),
+                  sizeof(addr)) == 0) {
+      break;
+    }
+    if (attempt > 300) {
+      *err = "connect to " + host + ":" + std::to_string(port) + " failed";
+      return nullptr;
+    }
+    ::usleep(100 * 1000);
+  }
+  std::string hello(4, '\0');
+  int32_t r32 = rank;
+  std::memcpy(hello.data(), &r32, 4);
+  if (!SendFrame(cp->sock_, hello)) {
+    *err = "hello send failed";
+    return nullptr;
+  }
+  return cp;
+}
+
+TcpControlPlane::~TcpControlPlane() {
+  if (sock_ >= 0) ::close(sock_);
+  for (int fd : worker_fds_)
+    if (fd >= 0) ::close(fd);
+  if (listen_fd_ >= 0) ::close(listen_fd_);
+}
+
+bool TcpControlPlane::Exchange(const RequestList& send, ResponseList* recv) {
+  std::string out;
+  Serialize(send, &out);
+  if (!SendFrame(sock_, out)) return false;
+  std::string in;
+  if (!RecvFrame(sock_, &in)) return false;
+  return Deserialize(in.data(), in.size(), recv);
+}
+
+bool TcpControlPlane::Gather(const RequestList& own,
+                             std::vector<RequestList>* all) {
+  all->assign(worker_fds_.size() + 1, RequestList{});
+  (*all)[0] = own;
+  for (size_t i = 0; i < worker_fds_.size(); ++i) {
+    std::string in;
+    if (!RecvFrame(worker_fds_[i], &in)) return false;
+    if (!Deserialize(in.data(), in.size(), &(*all)[i + 1])) return false;
+  }
+  return true;
+}
+
+bool TcpControlPlane::Broadcast(const ResponseList& out) {
+  std::string payload;
+  Serialize(out, &payload);
+  for (int fd : worker_fds_) {
+    if (!SendFrame(fd, payload)) return false;
+  }
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// Coordinator negotiation (reference IncrementTensorCount +
+// ConstructMPIResponse, operations.cc:282-307, 315-517)
+// ---------------------------------------------------------------------------
+
+Coordinator::Coordinator(int size, double stall_warning_seconds,
+                         bool stall_check)
+    : size_(size),
+      stall_seconds_(stall_warning_seconds),
+      stall_check_(stall_check),
+      last_stall_warn_(std::chrono::steady_clock::now()) {}
+
+void Coordinator::Ingest(const Request& req) {
+  auto it = table_.find(req.name);
+  if (it == table_.end()) {
+    TensorRecord rec;
+    rec.first = req;
+    rec.ready.assign(static_cast<size_t>(size_), false);
+    rec.first_dim_sizes.assign(static_cast<size_t>(size_), 0);
+    rec.first_seen = std::chrono::steady_clock::now();
+    it = table_.emplace(req.name, std::move(rec)).first;
+    fifo_.push_back(req.name);
+    if (timeline_ != nullptr) {
+      timeline_->NegotiateStart(req.name, OpTypeName(req.op));
+    }
+  }
+  TensorRecord& rec = it->second;
+  if (req.rank < 0 || req.rank >= size_) return;
+  if (rec.ready[static_cast<size_t>(req.rank)]) {
+    rec.error = "Duplicate request for tensor " + req.name + " from rank " +
+                std::to_string(req.rank) + " before completion.";
+    return;
+  }
+  rec.ready[static_cast<size_t>(req.rank)] = true;
+  rec.ready_count++;
+  if (timeline_ != nullptr) {
+    timeline_->NegotiateRankReady(req.name, req.rank);
+  }
+  if (!req.shape.dims.empty()) {
+    rec.first_dim_sizes[static_cast<size_t>(req.rank)] = req.shape.dims[0];
+  }
+  // Cross-rank consistency checks — these become coordinated ERROR responses
+  // on every rank instead of hangs (reference operations.cc:360-460).
+  std::ostringstream err;
+  if (req.op != rec.first.op) {
+    err << "Mismatched collective ops for tensor " << req.name << ": rank "
+        << req.rank << " requested " << OpTypeName(req.op) << " but rank "
+        << rec.first.rank << " requested " << OpTypeName(rec.first.op) << ".";
+  } else if (req.dtype != rec.first.dtype) {
+    err << "Mismatched dtypes for tensor " << req.name << ": rank "
+        << req.rank << " sent " << DataTypeName(req.dtype) << " but rank "
+        << rec.first.rank << " sent " << DataTypeName(rec.first.dtype) << ".";
+  } else if (req.op == OpType::BROADCAST &&
+             req.root_rank != rec.first.root_rank) {
+    err << "Mismatched root ranks for broadcast " << req.name << ": rank "
+        << req.rank << " used root " << req.root_rank << " but rank "
+        << rec.first.rank << " used root " << rec.first.root_rank << ".";
+  } else if ((req.op == OpType::ALLREDUCE || req.op == OpType::BROADCAST) &&
+             req.shape != rec.first.shape) {
+    err << "Mismatched shapes for " << OpTypeName(req.op) << " " << req.name
+        << ": rank " << req.rank << " sent " << req.shape.DebugString()
+        << " but rank " << rec.first.rank << " sent "
+        << rec.first.shape.DebugString() << ".";
+  } else if (req.op == OpType::ALLGATHER &&
+             (req.shape.dims.size() != rec.first.shape.dims.size() ||
+              !std::equal(req.shape.dims.begin() + (req.shape.dims.empty() ? 0 : 1),
+                          req.shape.dims.end(),
+                          rec.first.shape.dims.begin() + (rec.first.shape.dims.empty() ? 0 : 1)))) {
+    err << "Mismatched trailing shapes for allgather " << req.name
+        << " (only dim 0 may differ): rank " << req.rank << " sent "
+        << req.shape.DebugString() << " but rank " << rec.first.rank
+        << " sent " << rec.first.shape.DebugString() << ".";
+  }
+  std::string e = err.str();
+  if (!e.empty() && rec.error.empty()) rec.error = e;
+}
+
+Response Coordinator::Finalize(const std::string& name) {
+  TensorRecord& rec = table_.at(name);
+  if (timeline_ != nullptr) timeline_->NegotiateEnd(name);
+  Response resp;
+  resp.tensor_names.push_back(name);
+  if (!rec.error.empty()) {
+    resp.type = Response::Type::ERROR;
+    resp.error_reason = rec.error;
+  } else {
+    switch (rec.first.op) {
+      case OpType::ALLREDUCE: resp.type = Response::Type::ALLREDUCE; break;
+      case OpType::ALLGATHER:
+        resp.type = Response::Type::ALLGATHER;
+        resp.first_dim_sizes = rec.first_dim_sizes;
+        break;
+      case OpType::BROADCAST: resp.type = Response::Type::BROADCAST; break;
+      case OpType::ALLTOALL: resp.type = Response::Type::ALLTOALL; break;
+      case OpType::BARRIER: resp.type = Response::Type::BARRIER; break;
+    }
+  }
+  return resp;
+}
+
+ResponseList Coordinator::Tick(const std::vector<RequestList>& gathered) {
+  ResponseList out;
+  for (const auto& list : gathered) {
+    if (list.shutdown) out.shutdown = true;
+    for (const auto& req : list.requests) Ingest(req);
+  }
+  // Emit ready tensors in first-announcement order without skipping ahead of
+  // unready ones?  The reference pops every ready tensor each tick (readiness
+  // order), fusing adjacent same-type ones later; unready tensors simply
+  // remain.  We mirror that: scan FIFO, emit ready, keep the rest.
+  std::vector<std::string> remaining;
+  remaining.reserve(fifo_.size());
+  for (const auto& name : fifo_) {
+    auto it = table_.find(name);
+    if (it == table_.end()) continue;
+    TensorRecord& rec = it->second;
+    if (rec.ready_count >= size_ || !rec.error.empty()) {
+      out.responses.push_back(Finalize(name));
+      table_.erase(it);
+    } else {
+      remaining.push_back(name);
+    }
+  }
+  fifo_ = std::move(remaining);
+  return out;
+}
+
+std::string Coordinator::CheckStalled() {
+  if (!stall_check_ || table_.empty()) return "";
+  auto now = std::chrono::steady_clock::now();
+  if (std::chrono::duration<double>(now - last_stall_warn_).count() <
+      stall_seconds_) {
+    return "";
+  }
+  std::ostringstream msg;
+  bool any = false;
+  for (const auto& name : fifo_) {
+    auto it = table_.find(name);
+    if (it == table_.end()) continue;
+    const TensorRecord& rec = it->second;
+    double waited =
+        std::chrono::duration<double>(now - rec.first_seen).count();
+    if (waited < stall_seconds_) continue;
+    if (!any) {
+      msg << "One or more tensors were submitted to be reduced, gathered or "
+             "broadcasted by subset of ranks and are waiting for remainder of "
+             "ranks for more than " << static_cast<int>(stall_seconds_)
+          << " seconds. This may indicate that different ranks are trying to "
+             "submit different tensors or that only subset of ranks is "
+             "submitting tensors, which will cause deadlock.\n";
+      any = true;
+    }
+    msg << "Stalled op: " << name << " [missing ranks:";
+    for (int r = 0; r < size_; ++r) {
+      if (!rec.ready[static_cast<size_t>(r)]) msg << " " << r;
+    }
+    msg << "]\n";
+  }
+  if (!any) return "";
+  last_stall_warn_ = now;
+  return msg.str();
+}
+
+}  // namespace hvd

@@ -1,0 +1,133 @@
+// Control plane + readiness negotiation.
+//
+// TPU-native rebuild of the reference's rank-0 coordinator (reference
+// horovod/common/operations.cc:1694-1903): every cycle, each worker sends
+// the coordinator the list of tensors it has locally enqueued; the
+// coordinator counts readiness per name, validates cross-rank consistency
+// (op/dtype/shape/root), and broadcasts back an ordered ResponseList that
+// every process executes identically.  The transport is pluggable:
+// loopback for single-process jobs (the common TPU case — one process per
+// host already sees all local chips) and TCP for multi-host eager jobs,
+// standing in for the reference's MPI_Gather/Gatherv/Bcast.
+#pragma once
+
+#include <chrono>
+#include <map>
+#include <memory>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+#include "message.h"
+#include "timeline.h"
+
+namespace hvd {
+
+// Transport abstraction (reference: MPI collectives on mpi_comm).
+class ControlPlane {
+ public:
+  virtual ~ControlPlane() = default;
+  // Worker side: send this cycle's RequestList to the coordinator and
+  // receive the broadcast ResponseList.  Blocking; returns false on
+  // transport failure.
+  virtual bool Exchange(const RequestList& send, ResponseList* recv) = 0;
+  // Coordinator side: gather all workers' RequestLists (index = rank;
+  // coordinator's own list included), later Broadcast the verdict.
+  virtual bool Gather(const RequestList& own,
+                      std::vector<RequestList>* all) = 0;
+  virtual bool Broadcast(const ResponseList& out) = 0;
+  virtual bool is_coordinator() const = 0;
+};
+
+// Single-process transport: Exchange/Gather/Broadcast are pass-throughs.
+class LoopbackControlPlane : public ControlPlane {
+ public:
+  bool Exchange(const RequestList&, ResponseList*) override { return false; }
+  bool Gather(const RequestList& own, std::vector<RequestList>* all) override {
+    all->assign(1, own);
+    return true;
+  }
+  bool Broadcast(const ResponseList& out) override {
+    last = out;
+    return true;
+  }
+  bool is_coordinator() const override { return true; }
+  ResponseList last;
+};
+
+// TCP transport: coordinator (rank 0) accepts one persistent connection per
+// worker; frames are uint32-length-prefixed serialized messages.
+class TcpControlPlane : public ControlPlane {
+ public:
+  // Coordinator: bind+listen on port, accept size-1 workers (identified by a
+  // hello frame carrying their rank).  Worker: connect to host:port.
+  static std::unique_ptr<TcpControlPlane> MakeCoordinator(int port, int size,
+                                                          std::string* err);
+  static std::unique_ptr<TcpControlPlane> MakeWorker(const std::string& host,
+                                                     int port, int rank,
+                                                     std::string* err);
+  ~TcpControlPlane() override;
+
+  bool Exchange(const RequestList& send, ResponseList* recv) override;
+  bool Gather(const RequestList& own, std::vector<RequestList>* all) override;
+  bool Broadcast(const ResponseList& out) override;
+  bool is_coordinator() const override { return coordinator_; }
+  int bound_port() const { return port_; }
+
+ private:
+  TcpControlPlane() = default;
+  bool coordinator_ = false;
+  int port_ = 0;
+  int listen_fd_ = -1;
+  int sock_ = -1;                    // worker → coordinator
+  std::vector<int> worker_fds_;      // coordinator: index = rank-1
+};
+
+// Per-tensor negotiation record (reference message table,
+// operations.cc:282-307).
+struct TensorRecord {
+  Request first;                       // metadata from the first announcing rank
+  std::vector<bool> ready;             // which ranks announced
+  int ready_count = 0;
+  std::string error;                   // non-empty → coordinated error
+  std::vector<int64_t> first_dim_sizes;  // per-rank dim0 (allgather)
+  std::chrono::steady_clock::time_point first_seen;
+};
+
+// The coordinator's negotiation state machine.  Single-threaded use (from
+// the engine's background thread).
+class Coordinator {
+ public:
+  Coordinator(int size, double stall_warning_seconds, bool stall_check);
+
+  // Rank 0's timeline receives negotiation phases (reference hooks at
+  // operations.cc:292-304).  Not owned; may be null.
+  void SetTimeline(Timeline* t) { timeline_ = t; }
+
+  // Feed one cycle's gathered requests; returns the ordered responses whose
+  // tensors became globally ready this cycle (FIFO by first announcement,
+  // matching the reference's in-order response construction).
+  ResponseList Tick(const std::vector<RequestList>& gathered);
+
+  // Reference CheckForStalledTensors (operations.cc:1366-1412): returns a
+  // human-readable warning (empty if none) listing tensors waiting on
+  // missing ranks for longer than the stall window.
+  std::string CheckStalled();
+
+  size_t pending() const { return table_.size(); }
+
+ private:
+  void Ingest(const Request& req);
+  Response Finalize(const std::string& name);
+
+  int size_;
+  double stall_seconds_;
+  bool stall_check_;
+  Timeline* timeline_ = nullptr;
+  std::unordered_map<std::string, TensorRecord> table_;
+  std::vector<std::string> fifo_;      // names in first-announcement order
+  std::chrono::steady_clock::time_point last_stall_warn_;
+};
+
+}  // namespace hvd

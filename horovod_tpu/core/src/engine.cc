@@ -1,0 +1,337 @@
+#include "engine.h"
+
+#include <algorithm>
+#include <chrono>
+#include <cstdio>
+
+namespace hvd {
+
+Engine::Engine(EngineOptions opts) : opts_(std::move(opts)) {}
+
+Engine::~Engine() {
+  Shutdown();
+  if (thread_.joinable()) thread_.join();
+}
+
+Status Engine::Start(int* bound_port) {
+  if (!opts_.timeline_path.empty() && opts_.rank == 0) {
+    timeline_.Initialize(opts_.timeline_path);
+  }
+  if (opts_.size <= 1) {
+    control_ = std::make_unique<LoopbackControlPlane>();
+  } else if (opts_.rank == 0) {
+    std::string err;
+    auto cp = TcpControlPlane::MakeCoordinator(opts_.coordinator_port,
+                                               opts_.size, &err);
+    if (!cp) return Status::Unknown("control plane: " + err);
+    if (bound_port != nullptr) *bound_port = cp->bound_port();
+    control_ = std::move(cp);
+  } else {
+    std::string err;
+    auto cp = TcpControlPlane::MakeWorker(opts_.coordinator_host,
+                                          opts_.coordinator_port, opts_.rank,
+                                          &err);
+    if (!cp) return Status::Unknown("control plane: " + err);
+    control_ = std::move(cp);
+  }
+  if (control_->is_coordinator()) {
+    coordinator_ = std::make_unique<Coordinator>(
+        opts_.size, opts_.stall_warning_seconds, opts_.stall_check);
+    if (timeline_.Initialized()) coordinator_->SetTimeline(&timeline_);
+  }
+  thread_ = std::thread(&Engine::Loop, this);
+  return Status::OK();
+}
+
+void Engine::Shutdown() { shutdown_requested_.store(true); }
+
+int64_t Engine::Enqueue(const std::string& name, OpType op, DataType dtype,
+                        const TensorShape& shape, int32_t root_rank,
+                        Status* status) {
+  std::lock_guard<std::mutex> l(mu_);
+  if (stopped_.load() || shutdown_requested_.load()) {
+    *status = Status::Aborted("Horovod engine has been shut down.");
+    return -1;
+  }
+  if (inflight_.count(name) != 0) {
+    // Reference EnqueueTensorAllreduce duplicate-name check
+    // (operations.cc:2035-2040): a second request for a name still in
+    // flight is a client error, reported immediately.
+    *status = Status::InvalidArgument(
+        "Duplicate tensor name " + name +
+        "; a previous request for this tensor has not completed.");
+    return -1;
+  }
+  Request req;
+  req.rank = opts_.rank;
+  req.op = op;
+  req.dtype = dtype;
+  req.root_rank = root_rank;
+  req.name = name;
+  req.shape = shape;
+  int64_t handle = next_handle_++;
+  handles_[handle] = HandleState{};
+  inflight_[name] = {handle, req};
+  pending_enqueues_.emplace_back(handle, std::move(req));
+  *status = Status::OK();
+  return handle;
+}
+
+void Engine::Loop() {
+  using clock = std::chrono::steady_clock;
+  auto cycle = std::chrono::duration<double, std::milli>(opts_.cycle_time_ms);
+  while (!stopped_.load()) {
+    auto start = clock::now();
+    RunCycle();
+    // Sleep out the remainder of the cycle (reference operations.cc:1696-1703).
+    auto elapsed = clock::now() - start;
+    if (elapsed < cycle) {
+      std::this_thread::sleep_for(cycle - elapsed);
+    }
+  }
+}
+
+void Engine::RunCycle() {
+  RequestList own;
+  {
+    std::lock_guard<std::mutex> l(mu_);
+    for (auto& [handle, req] : pending_enqueues_) {
+      own.requests.push_back(req);
+    }
+    pending_enqueues_.clear();
+  }
+  own.shutdown = shutdown_requested_.load();
+
+  ResponseList responses;
+  if (control_->is_coordinator()) {
+    std::vector<RequestList> gathered;
+    if (!control_->Gather(own, &gathered)) {
+      FailAllPending(Status::Aborted("control plane gather failed"));
+      stopped_.store(true);
+      exec_cv_.notify_all();
+      return;
+    }
+    responses = coordinator_->Tick(gathered);
+    std::string stall = coordinator_->CheckStalled();
+    if (!stall.empty()) {
+      std::fprintf(stderr, "WARNING: %s", stall.c_str());
+    }
+    if (!control_->Broadcast(responses)) {
+      FailAllPending(Status::Aborted("control plane broadcast failed"));
+      stopped_.store(true);
+      exec_cv_.notify_all();
+      return;
+    }
+  } else {
+    if (!control_->Exchange(own, &responses)) {
+      FailAllPending(Status::Aborted("control plane exchange failed"));
+      stopped_.store(true);
+      exec_cv_.notify_all();
+      return;
+    }
+  }
+
+  DispatchResponses(responses);
+
+  if (responses.shutdown) {
+    // Coordinated shutdown: fail whatever never became ready with the
+    // reference's "shut down in progress" error (operations.cc:1647-1662).
+    FailAllPending(Status::Aborted(
+        "Horovod has been shut down. This was caused by an exit or shutdown "
+        "request on one of the ranks; pending collectives were aborted."));
+    stopped_.store(true);
+    exec_cv_.notify_all();
+  }
+}
+
+void Engine::DispatchResponses(const ResponseList& responses) {
+  std::lock_guard<std::mutex> l(mu_);
+  // Fuse adjacent same-type/same-dtype ALLREDUCE responses up to the byte
+  // threshold — in-order, no skipping (reference fusion loop,
+  // operations.cc:1807-1842).  Other op types execute one per batch.
+  size_t i = 0;
+  const auto& rs = responses.responses;
+  while (i < rs.size()) {
+    const Response& r = rs[i];
+    // Look up without erasing: the name stays "in flight" (blocking duplicate
+    // enqueues) until BatchDone — the reference frees a name only when its
+    // callback fires (operations.cc:2035-2040 duplicate check semantics).
+    auto take = [&](const std::string& name)
+        -> std::pair<int64_t, Request> {
+      auto it = inflight_.find(name);
+      if (it == inflight_.end()) return {-1, Request{}};
+      return it->second;
+    };
+
+    if (r.type == Response::Type::ERROR) {
+      auto [handle, req] = take(r.tensor_names[0]);
+      if (handle >= 0) {
+        inflight_.erase(r.tensor_names[0]);
+        MarkDone(handle, Status::PreconditionError(r.error_reason));
+      }
+      ++i;
+      continue;
+    }
+    if (r.type == Response::Type::BARRIER) {
+      auto [handle, req] = take(r.tensor_names[0]);
+      if (handle >= 0) {
+        inflight_.erase(r.tensor_names[0]);
+        MarkDone(handle, Status::OK());
+      }
+      ++i;
+      continue;
+    }
+
+    ExecBatch batch;
+    batch.id = next_batch_id_++;
+    batch.type = r.type;
+
+    auto append = [&](const Response& resp) {
+      for (const auto& name : resp.tensor_names) {
+        auto [handle, req] = take(name);
+        if (handle < 0) continue;  // not ours?  (should not happen: SPMD)
+        batch.names.push_back(name);
+        batch.handles.push_back(handle);
+        batch.shapes.push_back(req.shape);
+        batch.dtype = req.dtype;
+        batch.root_rank = req.root_rank;
+      }
+      batch.first_dim_sizes.insert(batch.first_dim_sizes.end(),
+                                   resp.first_dim_sizes.begin(),
+                                   resp.first_dim_sizes.end());
+    };
+    append(r);
+
+    if (r.type == Response::Type::ALLREDUCE && !batch.shapes.empty()) {
+      int64_t bytes = 0;
+      for (const auto& s : batch.shapes) {
+        bytes += s.num_elements() * DataTypeSize(batch.dtype);
+      }
+      while (i + 1 < rs.size() &&
+             rs[i + 1].type == Response::Type::ALLREDUCE) {
+        // Peek the next response's dtype/bytes from our inflight table.
+        const Response& nxt = rs[i + 1];
+        auto it = inflight_.find(nxt.tensor_names[0]);
+        if (it == inflight_.end()) break;
+        const Request& req = it->second.second;
+        int64_t add = req.shape.num_elements() * DataTypeSize(req.dtype);
+        if (req.dtype != batch.dtype ||
+            bytes + add > opts_.fusion_threshold_bytes) {
+          break;
+        }
+        ++i;
+        append(nxt);
+        bytes += add;
+      }
+    }
+
+    if (!batch.names.empty()) {
+      if (timeline_.Initialized()) {
+        for (const auto& n : batch.names) {
+          timeline_.ActivityStart(n, "QUEUE_EXEC");
+        }
+      }
+      executing_[batch.id] = batch;
+      exec_queue_.push_back(std::move(batch));
+      exec_cv_.notify_one();
+    }
+    ++i;
+  }
+}
+
+int Engine::NextBatch(ExecBatch* out, double timeout_ms) {
+  std::unique_lock<std::mutex> l(mu_);
+  if (!exec_cv_.wait_for(
+          l, std::chrono::duration<double, std::milli>(timeout_ms),
+          [&] { return !exec_queue_.empty() || stopped_.load(); })) {
+    return 0;
+  }
+  if (!exec_queue_.empty()) {
+    *out = std::move(exec_queue_.front());
+    exec_queue_.pop_front();
+    return 1;
+  }
+  return stopped_.load() ? -1 : 0;
+}
+
+void Engine::RequeueBatch(ExecBatch batch) {
+  std::lock_guard<std::mutex> l(mu_);
+  exec_queue_.push_front(std::move(batch));
+  exec_cv_.notify_one();
+}
+
+void Engine::BatchDone(int64_t batch_id, const Status& status) {
+  std::lock_guard<std::mutex> l(mu_);
+  auto it = executing_.find(batch_id);
+  if (it == executing_.end()) return;
+  for (size_t k = 0; k < it->second.handles.size(); ++k) {
+    if (timeline_.Initialized()) {
+      timeline_.ActivityEnd(it->second.names[k]);
+      timeline_.End(it->second.names[k], status.ok() ? "DONE" : "ERROR");
+    }
+    inflight_.erase(it->second.names[k]);
+    MarkDone(it->second.handles[k], status);
+  }
+  executing_.erase(it);
+}
+
+void Engine::FailAllPending(const Status& status) {
+  std::lock_guard<std::mutex> l(mu_);
+  for (auto& [handle, req] : pending_enqueues_) MarkDone(handle, status);
+  pending_enqueues_.clear();
+  for (auto& [name, hr] : inflight_) MarkDone(hr.first, status);
+  inflight_.clear();
+  for (auto& [id, batch] : executing_) {
+    for (auto h : batch.handles) MarkDone(h, status);
+  }
+  executing_.clear();
+  exec_queue_.clear();
+}
+
+void Engine::MarkDone(int64_t handle, const Status& status) {
+  // mu_ held by callers.
+  auto it = handles_.find(handle);
+  if (it == handles_.end()) return;
+  it->second.done = true;
+  it->second.status = status;
+  done_cv_.notify_all();
+}
+
+bool Engine::PollHandle(int64_t handle) {
+  std::lock_guard<std::mutex> l(mu_);
+  auto it = handles_.find(handle);
+  return it == handles_.end() || it->second.done;
+}
+
+bool Engine::WaitHandle(int64_t handle, double timeout_ms) {
+  std::unique_lock<std::mutex> l(mu_);
+  return done_cv_.wait_for(
+      l, std::chrono::duration<double, std::milli>(timeout_ms), [&] {
+        auto it = handles_.find(handle);
+        return it == handles_.end() || it->second.done;
+      });
+}
+
+Status Engine::PeekHandle(int64_t handle) {
+  std::lock_guard<std::mutex> l(mu_);
+  auto it = handles_.find(handle);
+  if (it == handles_.end()) {
+    return Status::InvalidArgument("unknown handle");
+  }
+  return it->second.done ? it->second.status
+                         : Status{StatusType::IN_PROGRESS, ""};
+}
+
+Status Engine::ReleaseHandle(int64_t handle) {
+  std::lock_guard<std::mutex> l(mu_);
+  auto it = handles_.find(handle);
+  if (it == handles_.end()) {
+    return Status::InvalidArgument("unknown handle");
+  }
+  Status s = it->second.done ? it->second.status
+                             : Status{StatusType::IN_PROGRESS, ""};
+  if (it->second.done) handles_.erase(it);
+  return s;
+}
+
+}  // namespace hvd

@@ -1,0 +1,129 @@
+// The background coordination engine.
+//
+// Rebuild of the reference's per-process runtime (reference
+// horovod/common/operations.cc: HorovodGlobalState :112-247,
+// BackgroundThreadLoop :1435-1663, RunLoopOnce :1694-1903,
+// EnqueueTensor* :2025-2141) with the execution half inverted: the reference
+// background thread performs MPI/NCCL collectives itself; here it only
+// *negotiates and schedules* — fused, ordered ExecBatches are handed to the
+// embedding runtime (Python/JAX) through a polling queue, the collective
+// itself is an XLA program on the TPU, and completion flows back via
+// BatchDone.  This keeps the dynamic/eager path's cross-host ordering
+// guarantees (SURVEY §7 hard-part (a)) native while the data plane stays
+// compiled.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+#include "controller.h"
+#include "message.h"
+#include "timeline.h"
+
+namespace hvd {
+
+// One fused unit of work for the executor (the analog of a fused
+// MPIResponse reaching PerformOperation, reference operations.cc:714).
+struct ExecBatch {
+  int64_t id = 0;
+  Response::Type type = Response::Type::ALLREDUCE;
+  DataType dtype = DataType::FLOAT32;
+  int32_t root_rank = -1;
+  // Parallel arrays: tensor names and their client handles.
+  std::vector<std::string> names;
+  std::vector<int64_t> handles;
+  std::vector<TensorShape> shapes;
+  std::vector<int64_t> first_dim_sizes;  // allgather: per-rank dim0 (fused: per tensor × rank)
+};
+
+struct EngineOptions {
+  int rank = 0;
+  int size = 1;
+  double cycle_time_ms = 5.0;
+  int64_t fusion_threshold_bytes = 64 * 1024 * 1024;
+  double stall_warning_seconds = 60.0;
+  bool stall_check = true;
+  std::string timeline_path;      // empty = disabled
+  std::string coordinator_host;   // workers (rank>0)
+  int coordinator_port = 0;       // 0 = pick ephemeral (coordinator)
+};
+
+class Engine {
+ public:
+  explicit Engine(EngineOptions opts);
+  ~Engine();
+
+  // Bring up the control plane and start the background thread.  Returns
+  // error status on transport failure; fills bound_port for coordinators.
+  Status Start(int* bound_port);
+  void Shutdown();
+
+  // Thread-safe enqueue (reference EnqueueTensorAllreduce/...,
+  // operations.cc:2025-2141).  Returns a handle (>=0) or -1 with *status set
+  // (duplicate name, shut down).
+  int64_t Enqueue(const std::string& name, OpType op, DataType dtype,
+                  const TensorShape& shape, int32_t root_rank, Status* status);
+
+  // Executor API.  Blocks up to timeout_ms for the next fused batch.
+  // Returns: 1 = batch filled, 0 = timeout, -1 = shutdown (queue drained).
+  int NextBatch(ExecBatch* out, double timeout_ms);
+  // Return an un-executed batch to the front of the queue (e.g. the
+  // serialization buffer was too small and the caller will retry bigger).
+  void RequeueBatch(ExecBatch batch);
+  void BatchDone(int64_t batch_id, const Status& status);
+
+  // Handle table (reference torch/handle_manager.{h,cc}).
+  bool PollHandle(int64_t handle);                 // true = done
+  // Block until the handle completes (condvar wait, not a poll loop).
+  // Returns false on timeout.
+  bool WaitHandle(int64_t handle, double timeout_ms);
+  Status ReleaseHandle(int64_t handle);            // returns final status
+  Status PeekHandle(int64_t handle);
+
+  int rank() const { return opts_.rank; }
+  int size() const { return opts_.size; }
+
+ private:
+  void Loop();
+  void RunCycle();
+  void DispatchResponses(const ResponseList& responses);
+  void FailAllPending(const Status& status);
+  void MarkDone(int64_t handle, const Status& status);
+
+  EngineOptions opts_;
+  Timeline timeline_;
+  std::unique_ptr<ControlPlane> control_;
+  std::unique_ptr<Coordinator> coordinator_;  // rank 0 only
+
+  std::mutex mu_;
+  std::condition_variable exec_cv_;
+  std::condition_variable done_cv_;
+  std::deque<ExecBatch> exec_queue_;
+  std::deque<std::pair<int64_t, Request>> pending_enqueues_;
+  // Locally announced, not yet completed: name -> (handle, request).
+  std::unordered_map<std::string, std::pair<int64_t, Request>> inflight_;
+  // Batches handed to the executor, awaiting BatchDone.
+  std::unordered_map<int64_t, ExecBatch> executing_;
+  struct HandleState {
+    bool done = false;
+    Status status;
+  };
+  std::unordered_map<int64_t, HandleState> handles_;
+  int64_t next_handle_ = 0;
+  int64_t next_batch_id_ = 0;
+
+  std::atomic<bool> shutdown_requested_{false};
+  std::atomic<bool> stopped_{false};
+  std::thread thread_;
+};
+
+}  // namespace hvd

@@ -1,0 +1,146 @@
+#include "message.h"
+
+#include <cstring>
+
+namespace hvd {
+
+const char* OpTypeName(OpType t) {
+  switch (t) {
+    case OpType::ALLREDUCE: return "ALLREDUCE";
+    case OpType::ALLGATHER: return "ALLGATHER";
+    case OpType::BROADCAST: return "BROADCAST";
+    case OpType::ALLTOALL: return "ALLTOALL";
+    case OpType::BARRIER: return "BARRIER";
+  }
+  return "?";
+}
+
+namespace {
+
+constexpr size_t kMaxString = 1 << 20;   // sanity bound on names/reasons
+constexpr size_t kMaxVector = 1 << 20;   // sanity bound on element counts
+
+struct Writer {
+  std::string* out;
+  void u8(uint8_t v) { out->push_back(static_cast<char>(v)); }
+  void i32(int32_t v) { raw(&v, 4); }
+  void i64(int64_t v) { raw(&v, 8); }
+  void raw(const void* p, size_t n) {
+    out->append(reinterpret_cast<const char*>(p), n);
+  }
+  void str(const std::string& s) {
+    i32(static_cast<int32_t>(s.size()));
+    raw(s.data(), s.size());
+  }
+};
+
+struct Reader {
+  const char* p;
+  size_t left;
+  bool fail = false;
+
+  bool take(void* dst, size_t n) {
+    if (left < n) { fail = true; return false; }
+    std::memcpy(dst, p, n);
+    p += n;
+    left -= n;
+    return true;
+  }
+  uint8_t u8() { uint8_t v = 0; take(&v, 1); return v; }
+  int32_t i32() { int32_t v = 0; take(&v, 4); return v; }
+  int64_t i64() { int64_t v = 0; take(&v, 8); return v; }
+  std::string str() {
+    int32_t n = i32();
+    if (fail || n < 0 || static_cast<size_t>(n) > kMaxString ||
+        static_cast<size_t>(n) > left) {
+      fail = true;
+      return {};
+    }
+    std::string s(p, static_cast<size_t>(n));
+    p += n;
+    left -= n;
+    return s;
+  }
+};
+
+}  // namespace
+
+void Serialize(const RequestList& in, std::string* out) {
+  Writer w{out};
+  w.i32(static_cast<int32_t>(in.requests.size()));
+  for (const auto& r : in.requests) {
+    w.i32(r.rank);
+    w.u8(static_cast<uint8_t>(r.op));
+    w.u8(static_cast<uint8_t>(r.dtype));
+    w.i32(r.root_rank);
+    w.str(r.name);
+    w.i32(static_cast<int32_t>(r.shape.dims.size()));
+    for (auto d : r.shape.dims) w.i64(d);
+  }
+  w.u8(in.shutdown ? 1 : 0);
+}
+
+bool Deserialize(const char* data, size_t len, RequestList* out) {
+  Reader r{data, len};
+  int32_t n = r.i32();
+  if (r.fail || n < 0 || static_cast<size_t>(n) > kMaxVector) return false;
+  out->requests.clear();
+  out->requests.reserve(n);
+  for (int32_t i = 0; i < n; ++i) {
+    Request q;
+    q.rank = r.i32();
+    q.op = static_cast<OpType>(r.u8());
+    q.dtype = static_cast<DataType>(r.u8());
+    q.root_rank = r.i32();
+    q.name = r.str();
+    int32_t nd = r.i32();
+    if (r.fail || nd < 0 || static_cast<size_t>(nd) > kMaxVector) return false;
+    q.shape.dims.resize(nd);
+    for (int32_t d = 0; d < nd; ++d) q.shape.dims[d] = r.i64();
+    if (r.fail) return false;
+    out->requests.push_back(std::move(q));
+  }
+  out->shutdown = r.u8() != 0;
+  return !r.fail;
+}
+
+void Serialize(const ResponseList& in, std::string* out) {
+  Writer w{out};
+  w.i32(static_cast<int32_t>(in.responses.size()));
+  for (const auto& resp : in.responses) {
+    w.u8(static_cast<uint8_t>(resp.type));
+    w.str(resp.error_reason);
+    w.i32(static_cast<int32_t>(resp.tensor_names.size()));
+    for (const auto& s : resp.tensor_names) w.str(s);
+    w.i32(static_cast<int32_t>(resp.first_dim_sizes.size()));
+    for (auto d : resp.first_dim_sizes) w.i64(d);
+  }
+  w.u8(in.shutdown ? 1 : 0);
+}
+
+bool Deserialize(const char* data, size_t len, ResponseList* out) {
+  Reader r{data, len};
+  int32_t n = r.i32();
+  if (r.fail || n < 0 || static_cast<size_t>(n) > kMaxVector) return false;
+  out->responses.clear();
+  out->responses.reserve(n);
+  for (int32_t i = 0; i < n; ++i) {
+    Response resp;
+    resp.type = static_cast<Response::Type>(r.u8());
+    resp.error_reason = r.str();
+    int32_t nn = r.i32();
+    if (r.fail || nn < 0 || static_cast<size_t>(nn) > kMaxVector) return false;
+    resp.tensor_names.reserve(nn);
+    for (int32_t k = 0; k < nn; ++k) resp.tensor_names.push_back(r.str());
+    int32_t ns = r.i32();
+    if (r.fail || ns < 0 || static_cast<size_t>(ns) > kMaxVector) return false;
+    resp.first_dim_sizes.resize(ns);
+    for (int32_t k = 0; k < ns; ++k) resp.first_dim_sizes[k] = r.i64();
+    if (r.fail) return false;
+    out->responses.push_back(std::move(resp));
+  }
+  out->shutdown = r.u8() != 0;
+  return !r.fail;
+}
+
+}  // namespace hvd

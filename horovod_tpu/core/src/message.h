@@ -1,0 +1,78 @@
+// Wire protocol for the coordination control plane.
+//
+// The reference serializes MPIRequest/MPIResponse lists with FlatBuffers
+// (reference horovod/common/mpi_message.{h,cc}, wire/mpi_message.fbs) and
+// moves them with MPI_Gather/Bcast.  We use a hand-rolled little-endian
+// format (no vendored schema compiler; messages are small and the schema is
+// stable) moved over loopback or TCP (controller.h): workers send a
+// RequestList to the coordinator every cycle, the coordinator broadcasts a
+// ResponseList.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "common.h"
+
+namespace hvd {
+
+enum class OpType : int8_t {
+  ALLREDUCE = 0,
+  ALLGATHER = 1,
+  BROADCAST = 2,
+  ALLTOALL = 3,
+  BARRIER = 4,
+};
+
+const char* OpTypeName(OpType t);
+
+// One tensor's readiness announcement (reference MPIRequest:
+// mpi_message.h:48-90 — {request_rank, type, dtype, name, root_rank, device,
+// shape}; "device" is dropped: one process drives all its local chips).
+struct Request {
+  int32_t rank = 0;
+  OpType op = OpType::ALLREDUCE;
+  DataType dtype = DataType::FLOAT32;
+  int32_t root_rank = -1;
+  std::string name;
+  TensorShape shape;
+};
+
+struct RequestList {
+  std::vector<Request> requests;
+  bool shutdown = false;
+};
+
+// Coordinator verdict for one (possibly fused) set of tensors (reference
+// MPIResponse: mpi_message.h:119-154).
+struct Response {
+  enum class Type : int8_t {
+    ALLREDUCE = 0,
+    ALLGATHER = 1,
+    BROADCAST = 2,
+    ALLTOALL = 3,
+    BARRIER = 4,
+    ERROR = 5,
+  };
+  Type type = Type::ALLREDUCE;
+  std::vector<std::string> tensor_names;
+  std::string error_reason;
+  // Per-rank dim-0 sizes for ALLGATHER (reference's MPI_Allgatherv sizing,
+  // operations.cc:576-612).
+  std::vector<int64_t> first_dim_sizes;
+};
+
+struct ResponseList {
+  std::vector<Response> responses;
+  bool shutdown = false;
+};
+
+// Serialization: append to / read from a byte buffer.  Readers return false
+// on malformed input (truncation, absurd lengths).
+void Serialize(const RequestList& in, std::string* out);
+bool Deserialize(const char* data, size_t len, RequestList* out);
+void Serialize(const ResponseList& in, std::string* out);
+bool Deserialize(const char* data, size_t len, ResponseList* out);
+
+}  // namespace hvd

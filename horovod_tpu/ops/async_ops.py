@@ -1,0 +1,96 @@
+"""Asynchronous named collectives — the eager/handle API.
+
+The analog of the reference torch op layer (reference torch/mpi_ops.py:
+``allreduce_async/allgather_async/broadcast_async`` + ``poll`` +
+``synchronize``): each call announces a tensor to the native engine and
+returns an integer handle immediately; the background thread negotiates
+global readiness, fuses, and an executor runs the collective; ``synchronize``
+blocks on the handle and returns the result.
+
+This is the path whose cross-host ordering is NOT statically known (ops fire
+from framework callbacks in whatever order autograd produces) — exactly why
+the reference needs its coordinator, and why we keep one (SURVEY §7 hard
+part (a)).  Inside jit/shard_map use the compiled ops (collective_ops.py)
+instead.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+
+import numpy as np
+
+from horovod_tpu.core import engine as engine_mod
+from horovod_tpu.ops.compression import Compression
+
+_counter = itertools.count()
+_meta_lock = threading.Lock()
+_meta: dict[int, dict] = {}
+
+
+def _auto_name(prefix: str, name: str | None) -> str:
+    if name is not None:
+        return name
+    return f"{prefix}.noname.{next(_counter)}"
+
+
+def allreduce_async(tensor, average: bool = True, name: str | None = None,
+                    compression=Compression.none) -> int:
+    """Start a named allreduce; returns a handle (reference
+    torch/mpi_ops.py:69-107)."""
+    eng = engine_mod.get_engine()
+    arr = np.asarray(tensor)
+    compressed, ctx = compression.compress(arr)
+    compressed = np.asarray(compressed)
+    h = eng.enqueue(_auto_name("allreduce", name), compressed,
+                    engine_mod.OP_ALLREDUCE)
+    with _meta_lock:
+        _meta[h] = {"average": average, "compression": compression,
+                    "ctx": ctx}
+    return h
+
+
+def allgather_async(tensor, name: str | None = None) -> int:
+    """Start a named allgather (variable dim-0 supported; reference
+    torch/mpi_ops.py:228-276)."""
+    eng = engine_mod.get_engine()
+    h = eng.enqueue(_auto_name("allgather", name), np.asarray(tensor),
+                    engine_mod.OP_ALLGATHER)
+    with _meta_lock:
+        _meta[h] = {}
+    return h
+
+
+def broadcast_async(tensor, root_rank: int, name: str | None = None) -> int:
+    """Start a named broadcast from ``root_rank`` (reference
+    torch/mpi_ops.py:310-380)."""
+    eng = engine_mod.get_engine()
+    h = eng.enqueue(_auto_name("broadcast", name), np.asarray(tensor),
+                    engine_mod.OP_BROADCAST, root_rank=root_rank)
+    with _meta_lock:
+        _meta[h] = {}
+    return h
+
+
+def poll(handle: int) -> bool:
+    """True if the collective behind ``handle`` has completed (reference
+    torch/mpi_ops.py:408-419)."""
+    return engine_mod.get_engine().poll(handle)
+
+
+def synchronize(handle: int):
+    """Block until completion and return the result array (reference
+    torch/mpi_ops.py:422-438)."""
+    eng = engine_mod.get_engine()
+    with _meta_lock:
+        meta = _meta.pop(handle, {})
+    out = eng.synchronize(handle)
+    if out is None:
+        return None
+    if meta.get("average"):
+        out = (out / eng.size).astype(out.dtype)
+    comp = meta.get("compression")
+    if comp is not None:
+        out = np.asarray(comp.decompress(out, meta.get("ctx")))
+    return out

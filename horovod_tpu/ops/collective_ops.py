@@ -47,15 +47,47 @@ from horovod_tpu.ops import fusion
 Average = True  # default matches reference allreduce(average=True)
 
 
-def _in_mesh_axes() -> tuple[str, ...] | None:
-    """Return the data axis names if we are tracing under a mesh context with
-    them bound (shard_map/pmap), else None."""
-    axes = mesh.data_axes()
+def _bound_axis_names() -> tuple[str, ...]:
+    """Mesh axis names bound by an enclosing shard_map/pmap trace."""
     try:
-        lax.axis_index(axes if len(axes) > 1 else axes[0])
-        return axes
-    except NameError:
+        from jax._src import core as _core
+        env = _core.get_axis_env()
+        return tuple(env.axis_sizes.keys())
+    except Exception:  # pragma: no cover - private-API drift fallback
+        found = []
+        for name in (*mesh.data_axes(), mesh.DATA_AXIS, mesh.DCN_AXIS,
+                     mesh.ICI_AXIS):
+            try:
+                lax.axis_size(name)
+                found.append(name)
+            except NameError:
+                pass
+        return tuple(dict.fromkeys(found))
+
+
+def _in_mesh_axes() -> tuple[str, ...] | None:
+    """Return the data-parallel axis names collectives should reduce over, or
+    None when called eagerly (no mesh axis bound → process-level semantics).
+
+    Preference order: the global mesh's data axes when bound; a bound
+    (dcn, ici) hierarchical pair; a bound "hvd" axis; a single bound axis of
+    any name (custom user meshes).  Multiple bound axes that match none of
+    these are ambiguous between data and model axes — reduce over the global
+    mesh convention only.
+    """
+    bound = _bound_axis_names()
+    if not bound:
         return None
+    ours = mesh.data_axes()
+    if all(a in bound for a in ours):
+        return ours
+    if mesh.DCN_AXIS in bound and mesh.ICI_AXIS in bound:
+        return (mesh.DCN_AXIS, mesh.ICI_AXIS)
+    if mesh.DATA_AXIS in bound:
+        return (mesh.DATA_AXIS,)
+    if len(bound) == 1:
+        return bound
+    return None
 
 
 def _data_width(axes: tuple[str, ...]) -> int:

@@ -56,6 +56,13 @@ def stall_check_disabled() -> bool:
     return _get("STALL_CHECK_DISABLE") is not None
 
 
+def stall_warning_seconds() -> float:
+    """Stall-warning window; reference hardcodes 60 s (operations.cc:253) —
+    exposed as a knob here mainly so tests can shrink it."""
+    raw = _get("STALL_WARNING_TIME")
+    return float(raw) if raw else STALL_WARNING_TIME_SECONDS
+
+
 def hierarchical_allreduce() -> bool:
     raw = _get("HIERARCHICAL_ALLREDUCE")
     return bool(raw) and raw not in ("0", "false", "False")

@@ -1,0 +1,46 @@
+"""hvd.*_async / poll / synchronize — the eager handle API
+(reference torch/mpi_ops.py surface; test matrix from test_torch.py:175-223)."""
+
+import numpy as np
+import pytest
+
+
+def test_allreduce_async_roundtrip(hvd):
+    x = np.arange(8, dtype=np.float32)
+    h = hvd.allreduce_async(x, average=True, name="a0")
+    out = hvd.synchronize(h)
+    np.testing.assert_allclose(out, x)  # size 1: average is identity
+
+
+def test_allreduce_async_fp16_compression(hvd):
+    x = np.linspace(-2, 2, 16, dtype=np.float32)
+    h = hvd.allreduce_async(x, average=False, name="a1",
+                            compression=hvd.Compression.fp16)
+    out = hvd.synchronize(h)
+    assert out.dtype == np.float32
+    np.testing.assert_allclose(out, x, atol=1e-2)
+
+
+def test_allgather_broadcast_async(hvd):
+    x = np.ones((3, 2), np.int32)
+    np.testing.assert_array_equal(
+        hvd.synchronize(hvd.allgather_async(x, name="g0")), x)
+    np.testing.assert_array_equal(
+        hvd.synchronize(hvd.broadcast_async(x, root_rank=0, name="b0")), x)
+
+
+def test_poll_eventually_true(hvd):
+    h = hvd.allreduce_async(np.ones(4, np.float32), name="p0")
+    import time
+
+    deadline = time.monotonic() + 10
+    while not hvd.poll(h) and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert hvd.poll(h)
+    hvd.synchronize(h)
+
+
+def test_auto_names_unique(hvd):
+    hs = [hvd.allreduce_async(np.ones(4, np.float32)) for _ in range(5)]
+    for h in hs:
+        hvd.synchronize(h)

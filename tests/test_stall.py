@@ -1,0 +1,56 @@
+"""Stall detection: rank 0 must warn about tensors stuck waiting for
+missing ranks (reference CheckForStalledTensors, operations.cc:1366-1412,
+60 s window; shrunk here via HOROVOD_STALL_WARNING_TIME)."""
+
+import socket
+import subprocess
+import sys
+import textwrap
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+SCRIPT = textwrap.dedent("""
+    import sys, time
+    import numpy as np
+    from horovod_tpu.core.engine import NativeEngine, OP_ALLREDUCE
+    from horovod_tpu.core.executors import local_executor
+
+    rank, port = int(sys.argv[1]), int(sys.argv[2])
+    eng = NativeEngine(rank, 2, executor=local_executor,
+                       coordinator_host="127.0.0.1", coordinator_port=port,
+                       cycle_time_ms=2.0)
+    if rank == 0:
+        # Only rank 0 announces: the tensor can never become ready.
+        eng.enqueue("lonely", np.ones(4, np.float32), OP_ALLREDUCE)
+    time.sleep(1.2)
+    print("ALIVE", flush=True)
+    eng._shutdown.set()   # skip graceful shutdown: peer may already be gone
+""")
+
+
+def test_stall_warning():
+    port = _free_port()
+    env = {"HOROVOD_STALL_WARNING_TIME": "0.3", "PYTHONPATH": "."}
+    import os
+
+    env = {**os.environ, **env}
+    procs = [
+        subprocess.Popen([sys.executable, "-c", SCRIPT, str(r), str(port)],
+                         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                         env=env, text=True, cwd=os.path.dirname(
+                             os.path.dirname(os.path.abspath(__file__))))
+        for r in range(2)
+    ]
+    outs = [p.communicate(timeout=60) for p in procs]
+    assert "ALIVE" in outs[0][0]
+    assert "ALIVE" in outs[1][0]
+    stderr0 = outs[0][1]
+    assert "Stalled op: lonely" in stderr0, stderr0
+    assert "missing ranks: 1" in stderr0, stderr0
