@@ -81,16 +81,21 @@ def main() -> None:
             params, batch_stats, opt_state, x, y)
         return loss
 
+    loss = None
     for _ in range(warmup):
         loss = run_one()
-    jax.block_until_ready(loss)
+    if loss is not None:
+        float(loss)  # hard sync: device-to-host fetch
 
+    # Sync each timed window with an explicit host fetch of the final loss:
+    # on tunneled backends block_until_ready alone returns early and
+    # over-reports throughput wildly (docs/benchmarks.md methodology).
     rates = []
     for _ in range(iters):
         t0 = time.perf_counter()
         for _ in range(batches_per_iter):
             loss = run_one()
-        jax.block_until_ready(loss)
+        float(loss)
         dt = time.perf_counter() - t0
         rates.append(batch * n_chips * batches_per_iter / dt)
 

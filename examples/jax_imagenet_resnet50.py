@@ -1,0 +1,122 @@
+"""ImageNet ResNet-50 — the flagship data-parallel recipe.
+
+Analog of reference examples/pytorch_imagenet_resnet50.py and
+examples/keras_imagenet_resnet50.py: per-process data sharding, LR =
+base × num_chips with 5-epoch gradual warmup and staircase decay at
+30/60/80, bf16 compute with fp32 params, checkpoint/resume with the
+rank-0-writes + broadcast-resume-epoch contract (reference :63-72).
+
+Real ImageNet loading is environment-specific; --synthetic (default) uses
+random data with the exact compute shape, which is also how the reference
+benchmarks (docs/benchmarks.md:24-44 synthetic mode).
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.models import ResNet50
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=90)
+    ap.add_argument("--steps-per-epoch", type=int, default=10)
+    ap.add_argument("--batch-size", type=int, default=32,
+                    help="per-chip batch size")
+    ap.add_argument("--base-lr", type=float, default=0.0125)
+    ap.add_argument("--warmup-epochs", type=float, default=5)
+    ap.add_argument("--wd", type=float, default=5e-5)
+    ap.add_argument("--ckpt-dir", default="/tmp/hvd_tpu_resnet50")
+    ap.add_argument("--synthetic", action="store_true", default=True)
+    args = ap.parse_args()
+
+    hvd.init()
+
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    rng = jax.random.PRNGKey(0)
+    sample = jnp.zeros((2, 224, 224, 3))
+    variables = model.init(rng, sample, train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    # LR schedule: linear scaling + warmup + staircase 30/60/80 decay
+    # (reference pytorch_imagenet_resnet50.py:120-139 adjust_learning_rate).
+    size = hvd.num_chips()
+    spe = args.steps_per_epoch
+
+    def lr_schedule(step):
+        epoch = step / spe
+        warm = args.base_lr * (1.0 + epoch / args.warmup_epochs * (size - 1))
+        scaled = args.base_lr * size * (
+            0.1 ** jnp.floor(epoch / 30))  # 30/60/90 staircase
+        return jnp.where(epoch < args.warmup_epochs,
+                         jnp.minimum(warm, args.base_lr * size), scaled)
+
+    opt = hvd.DistributedOptimizer(
+        optax.chain(optax.add_decayed_weights(args.wd),
+                    optax.sgd(lr_schedule, momentum=0.9, nesterov=True)),
+        compression=hvd.Compression.bf16)
+    opt_state = opt.init(params)
+
+    # Resume (reference :63-72): rank 0 lists checkpoints, the resume epoch
+    # is broadcast, state restored + broadcast.
+    resume = hvd.checkpoint.resume_epoch(args.ckpt_dir)
+    if resume:
+        restored = hvd.checkpoint.restore_epoch(
+            args.ckpt_dir, resume,
+            {"params": params, "batch_stats": batch_stats})
+        params, batch_stats = restored["params"], restored["batch_stats"]
+        if hvd.rank() == 0:
+            print(f"resumed from epoch {resume}")
+    params = hvd.broadcast_parameters(params, root_rank=0)
+    batch_stats = hvd.broadcast_parameters(batch_stats, root_rank=0)
+
+    @jax.jit
+    @hvd.shard(in_specs=(P(), P(), P(), hvd.batch_spec(4), hvd.batch_spec(1)),
+               out_specs=(P(), P(), P(), P()))
+    def train_step(params, batch_stats, opt_state, x, y):
+        def loss_fn(p):
+            logits, mutated = model.apply(
+                {"params": p, "batch_stats": batch_stats}, x, train=True,
+                mutable=["batch_stats"])
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean()
+            return loss, mutated["batch_stats"]
+
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return (optax.apply_updates(params, updates), new_stats, opt_state,
+                loss)
+
+    gb = args.batch_size * size
+    rng_np = np.random.RandomState(hvd.rank())
+
+    for epoch in range(resume, args.epochs):
+        t0 = time.time()
+        loss = None
+        for _ in range(spe):
+            x = jnp.asarray(rng_np.rand(gb, 224, 224, 3), jnp.float32)
+            y = jnp.asarray(rng_np.randint(0, 1000, gb))
+            params, batch_stats, opt_state, loss = train_step(
+                params, batch_stats, opt_state, x, y)
+        jax.block_until_ready(loss)
+        dt = time.time() - t0
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: loss={float(loss):.3f} "
+                  f"{spe * gb / dt:.1f} img/s")
+        hvd.checkpoint.save_epoch(args.ckpt_dir, epoch,
+                                  {"params": params,
+                                   "batch_stats": batch_stats})
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,91 @@
+"""Long-context training with ring attention — sequence parallelism.
+
+No reference analog (the reference predates sequence parallelism; SURVEY
+§2.9) — this is the first-class long-context path the TPU rebuild adds: the
+sequence dimension is sharded over the mesh, ring attention streams K/V
+blocks around the ICI ring (parallel/ring_attention.py), and each chip only
+ever holds S/n of the activations, so max trainable context scales linearly
+with chips.  Swap ``make_ring_attention`` for ``make_ulysses_attention`` to
+use all-to-all head parallelism instead.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.models import Transformer, TransformerConfig
+from horovod_tpu.parallel import make_ring_attention
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq-len", type=int, default=8192)
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--embed", type=int, default=512)
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--batch", type=int, default=1)
+    args = ap.parse_args()
+
+    hvd.init()
+    n = hvd.num_chips()
+    mesh = Mesh(np.array(jax.devices()), ("sp",))
+    s_local = args.seq_len // n
+
+    base = dict(vocab_size=32000, num_layers=args.layers,
+                num_heads=args.heads, head_dim=args.embed // args.heads,
+                embed_dim=args.embed, mlp_dim=4 * args.embed,
+                max_seq_len=args.seq_len)
+    model = Transformer(TransformerConfig(
+        **base, attention_fn=make_ring_attention("sp")))
+    init_model = Transformer(TransformerConfig(**base))
+    params = init_model.init(jax.random.PRNGKey(0),
+                             jnp.zeros((1, s_local), jnp.int32))
+    opt = optax.adamw(3e-4)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def train_step(params, opt_state, tokens):
+        def sharded(params, tokens):
+            def loss_fn(p):
+                offset = jax.lax.axis_index("sp") * s_local
+                logits = model.apply(p, tokens, position_offset=offset)
+                loss = optax.softmax_cross_entropy_with_integer_labels(
+                    logits[:, :-1], tokens[:, 1:]).mean()
+                # Mean over sequence shards = global mean over the sequence.
+                return jax.lax.pmean(loss, "sp")
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            grads = jax.tree.map(lambda g: jax.lax.pmean(g, "sp"), grads)
+            return grads, loss
+
+        grads, loss = jax.shard_map(
+            sharded, mesh=mesh, in_specs=(P(), P(None, "sp")),
+            out_specs=(P(), P()), check_vma=False)(params, tokens)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, 32000, (args.batch, args.seq_len)))
+    loss = None
+    for i in range(args.steps):
+        t0 = time.time()
+        params, opt_state, loss = train_step(params, opt_state, tokens)
+        jax.block_until_ready(loss)
+        if hvd.rank() == 0:
+            tok_s = args.batch * args.seq_len / (time.time() - t0)
+            print(f"step {i}: loss={float(loss):.3f} {tok_s:.0f} tok/s "
+                  f"(seq {args.seq_len} over {n} chips, "
+                  f"{s_local}/chip)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,102 @@
+"""MNIST data-parallel training — the hello-world example.
+
+Analog of reference examples/tensorflow_mnist.py (MonitoredTrainingSession
+pattern) and examples/pytorch_mnist.py: init, shard the data by rank, scale
+the LR by worker count, wrap the optimizer, broadcast initial state, train,
+checkpoint on rank 0 only.
+
+Run (single host, all local chips):  python examples/jax_mnist.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.models import MnistCNN
+
+
+def synthetic_mnist(n=4096, seed=0):
+    """Deterministic stand-in for the MNIST download (no egress in CI)."""
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 28, 28, 1).astype(np.float32)
+    y = (x.mean(axis=(1, 2, 3)) * 10).astype(np.int32) % 10
+    return x, y
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=64,
+                    help="per-chip batch size")
+    ap.add_argument("--lr", type=float, default=0.001)
+    ap.add_argument("--ckpt-dir", default="/tmp/hvd_tpu_mnist")
+    args = ap.parse_args()
+
+    # Horovod: initialize (reference tensorflow_mnist.py:23).
+    hvd.init()
+
+    model = MnistCNN()
+    rng = jax.random.PRNGKey(42)
+    params = model.init(rng, jnp.zeros((1, 28, 28, 1)))
+
+    # Horovod: scale the LR by total workers (reference :52-54).
+    opt = hvd.DistributedOptimizer(
+        optax.sgd(hvd.scale_learning_rate(args.lr), momentum=0.9))
+    opt_state = opt.init(params)
+
+    # Horovod: broadcast initial state from rank 0 (reference
+    # BroadcastGlobalVariablesHook, :88-92).
+    params = hvd.broadcast_parameters(params, root_rank=0)
+
+    global_batch = args.batch_size * hvd.num_chips()
+
+    @jax.jit
+    @hvd.shard(in_specs=(P(), P(), hvd.batch_spec(4), hvd.batch_spec(1)),
+               out_specs=(P(), P(), P()))
+    def train_step(params, opt_state, x, y):
+        def loss_fn(p):
+            logits = model.apply(p, x)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    # Data sharding by process (reference DistributedSampler pattern,
+    # pytorch_mnist.py:93-96): each process keeps its slice; within the
+    # process the mesh shards the per-host batch over local chips.
+    x_all, y_all = synthetic_mnist()
+    x_all = x_all[hvd.rank()::hvd.size()]
+    y_all = y_all[hvd.rank()::hvd.size()]
+    steps = len(x_all) // global_batch
+
+    for epoch in range(args.epochs):
+        t0 = time.time()
+        loss = None
+        for s in range(steps):
+            lo = s * global_batch
+            xb = jnp.asarray(x_all[lo:lo + global_batch])
+            yb = jnp.asarray(y_all[lo:lo + global_batch])
+            params, opt_state, loss = train_step(params, opt_state, xb, yb)
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: loss={float(loss):.4f} "
+                  f"({time.time() - t0:.1f}s)")
+
+    # Horovod: checkpoint on rank 0 only (reference :108-110).
+    hvd.checkpoint.save_epoch(args.ckpt_dir, args.epochs - 1,
+                              {"params": params})
+    if hvd.rank() == 0:
+        print("done; checkpoint written to", args.ckpt_dir)
+
+
+if __name__ == "__main__":
+    main()

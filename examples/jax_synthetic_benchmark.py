@@ -1,0 +1,113 @@
+"""Synthetic throughput harness — img/sec with a fusion-threshold sweep.
+
+Analog of reference examples/pytorch_synthetic_benchmark.py:14-107: synthetic
+data, N warmup batches, ``num-iters × num-batches-per-iter`` timed batches,
+reporting img/sec mean ± 1.96σ per device and in total.  Adds ``--sweep`` to
+re-run across HOROVOD_FUSION_THRESHOLD values (SURVEY §7 milestone 6).
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu import models
+
+
+def build_step(model, opt):
+    @jax.jit
+    @hvd.shard(in_specs=(P(), P(), P(), hvd.batch_spec(4), hvd.batch_spec(1)),
+               out_specs=(P(), P(), P(), P()))
+    def train_step(params, batch_stats, opt_state, x, y):
+        def loss_fn(p):
+            logits, mutated = model.apply(
+                {"params": p, "batch_stats": batch_stats}, x, train=True,
+                mutable=["batch_stats"])
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean(), mutated["batch_stats"]
+
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return (optax.apply_updates(params, updates), new_stats, opt_state,
+                loss)
+
+    return train_step
+
+
+def run(args, threshold: int | None = None) -> float:
+    if threshold is not None:
+        import os
+
+        os.environ["HOROVOD_FUSION_THRESHOLD"] = str(threshold)
+    model_cls = getattr(models, args.model)
+    model = model_cls(num_classes=1000, dtype=jnp.bfloat16)
+    rng = jax.random.PRNGKey(0)
+    variables = model.init(rng, jnp.zeros((2, 224, 224, 3)), train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    opt = hvd.DistributedOptimizer(optax.sgd(0.01, momentum=0.9))
+    opt_state = opt.init(params)
+    step = build_step(model, opt)
+
+    gb = args.batch_size * hvd.num_chips()
+    x = jnp.asarray(np.random.rand(gb, 224, 224, 3), jnp.float32)
+    y = jnp.asarray(np.random.randint(0, 1000, gb))
+
+    def one():
+        nonlocal params, batch_stats, opt_state
+        params, batch_stats, opt_state, loss = step(params, batch_stats,
+                                                    opt_state, x, y)
+        return loss
+
+    for _ in range(args.num_warmup_batches):
+        loss = one()
+    jax.block_until_ready(loss)
+
+    img_secs = []
+    for _ in range(args.num_iters):
+        t0 = time.time()
+        for _ in range(args.num_batches_per_iter):
+            loss = one()
+        jax.block_until_ready(loss)
+        img_secs.append(gb * args.num_batches_per_iter / (time.time() - t0))
+
+    img_sec_mean = np.mean(img_secs)
+    img_sec_conf = 1.96 * np.std(img_secs)
+    if hvd.rank() == 0:
+        n = hvd.num_chips()
+        print(f"Img/sec per chip: {img_sec_mean / n:.1f} "
+              f"+-{img_sec_conf / n:.1f}")
+        print(f"Total img/sec on {n} chip(s): {img_sec_mean:.1f} "
+              f"+-{img_sec_conf:.1f}")
+    return float(img_sec_mean)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="ResNet50")
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--num-warmup-batches", type=int, default=10)
+    ap.add_argument("--num-iters", type=int, default=10)
+    ap.add_argument("--num-batches-per-iter", type=int, default=10)
+    ap.add_argument("--sweep", action="store_true",
+                    help="sweep HOROVOD_FUSION_THRESHOLD")
+    args = ap.parse_args()
+    hvd.init()
+    if args.sweep:
+        for mb in (1, 8, 64, 256):
+            rate = run(args, threshold=mb * 1024 * 1024)
+            if hvd.rank() == 0:
+                print(f"fusion_threshold={mb}MiB -> {rate:.1f} img/s")
+    else:
+        run(args)
+
+
+if __name__ == "__main__":
+    main()

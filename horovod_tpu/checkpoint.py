@@ -1,0 +1,81 @@
+"""Checkpoint / resume — the reference's contract, TPU-native storage.
+
+The reference delegates checkpoint IO to the framework and only enforces the
+*distributed contract* (SURVEY §5): (a) only rank 0 writes (reference
+README.md:102-104, examples/keras_imagenet_resnet50.py:157-160); (b) on
+resume, state is re-broadcast from rank 0 so late-loading or differently-
+seeded workers agree (reference tensorflow/__init__.py:131-133 hook,
+keras/__init__.py:115-148 ``load_model``, torch broadcast_* +
+examples/pytorch_imagenet_resnet50.py:63-72 epoch broadcast).
+
+Storage here is Orbax (the JAX-native checkpointer: async, sharding-aware,
+atomic renames); these helpers wrap it with the contract applied.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+
+from horovod_tpu import basics, training
+
+
+def save(path: str | os.PathLike, state: Any, *, force: bool = True) -> None:
+    """Write ``state`` (any pytree) at ``path``; no-op off rank 0."""
+    if basics.rank() != 0:
+        return
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(os.fspath(path))
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(path, state, force=force)
+
+
+def restore(path: str | os.PathLike, template: Any | None = None,
+            *, broadcast: bool = True, root_rank: int = 0) -> Any:
+    """Load a checkpoint and (by default) broadcast it from ``root_rank`` so
+    every worker resumes identically — the reference's resume contract."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(os.fspath(path))
+    with ocp.PyTreeCheckpointer() as ckptr:
+        if template is not None:
+            state = ckptr.restore(path, ocp.args.PyTreeRestore(template))
+        else:
+            state = ckptr.restore(path)
+    if broadcast and basics.size() > 1:
+        state = training.broadcast_parameters(state, root_rank=root_rank)
+    return state
+
+
+def exists(path: str | os.PathLike) -> bool:
+    return os.path.isdir(os.fspath(path))
+
+
+def resume_epoch(path: str | os.PathLike, root_rank: int = 0) -> int:
+    """Broadcast rank 0's view of the last finished epoch (the reference
+    broadcasts a ``resume_from_epoch`` scalar,
+    examples/pytorch_imagenet_resnet50.py:63-72): checkpoints are saved under
+    ``path/epoch_<N>``; workers may see stale filesystems, so only rank 0
+    lists."""
+    epoch = 0
+    if basics.rank() == root_rank and os.path.isdir(os.fspath(path)):
+        for entry in os.listdir(os.fspath(path)):
+            if entry.startswith("epoch_"):
+                try:
+                    epoch = max(epoch, int(entry.split("_", 1)[1]))
+                except ValueError:
+                    pass
+    return int(training.broadcast_object(epoch, root_rank=root_rank))
+
+
+def save_epoch(path: str | os.PathLike, epoch: int, state: Any) -> None:
+    save(os.path.join(os.fspath(path), f"epoch_{epoch}"), state)
+
+
+def restore_epoch(path: str | os.PathLike, epoch: int,
+                  template: Any | None = None, **kw) -> Any:
+    return restore(os.path.join(os.fspath(path), f"epoch_{epoch}"),
+                   template, **kw)

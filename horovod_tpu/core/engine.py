@@ -182,7 +182,7 @@ class NativeEngine:
         self._lib = lib()
         self._store: dict[str, np.ndarray] = {}
         self._results: dict[int, np.ndarray] = {}
-        self._handle_names: dict[int, str] = {}
+        self._handle_names: dict[int, tuple[str, np.ndarray]] = {}
         self._store_lock = threading.Lock()
         self._shutdown = threading.Event()
         from horovod_tpu.core import executors
@@ -236,7 +236,7 @@ class NativeEngine:
                 self._store.pop(name, None)
             raise CollectiveError(err.value.decode())
         with self._store_lock:
-            self._handle_names[int(h)] = name
+            self._handle_names[int(h)] = (name, arr)
         return int(h)
 
     def poll(self, handle: int) -> bool:
@@ -253,13 +253,15 @@ class NativeEngine:
         rc = self._lib.hvd_release(self._ptr, handle, err, 2048)
         with self._store_lock:
             result = self._results.pop(handle, None)
-            name = self._handle_names.pop(handle, None)
-            if rc != STATUS_OK and name is not None:
+            entry = self._handle_names.pop(handle, None)
+            if rc != STATUS_OK and entry is not None:
                 # On errors no executor ever took the input; free the name so
-                # later enqueues aren't rejected as duplicates.  (On success
-                # the executor consumed it — and the name may already belong
-                # to a newer request, which must not be disturbed.)
-                self._store.pop(name, None)
+                # later enqueues aren't rejected as duplicates — but only if
+                # the stored array is still OURS (a newer request may have
+                # legally reused the name after this handle failed).
+                name, arr = entry
+                if self._store.get(name) is arr:
+                    self._store.pop(name, None)
         if rc == STATUS_PRECONDITION:
             raise CollectiveError(err.value.decode())
         if rc != STATUS_OK:

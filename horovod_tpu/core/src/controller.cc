@@ -293,17 +293,19 @@ ResponseList Coordinator::Tick(const std::vector<RequestList>& gathered) {
     if (list.shutdown) out.shutdown = true;
     for (const auto& req : list.requests) Ingest(req);
   }
-  // Emit ready tensors in first-announcement order without skipping ahead of
-  // unready ones?  The reference pops every ready tensor each tick (readiness
-  // order), fusing adjacent same-type ones later; unready tensors simply
-  // remain.  We mirror that: scan FIFO, emit ready, keep the rest.
+  // Emit ready tensors in first-announcement order; unready tensors remain.
+  // IMPORTANT: even errored tensors wait for ALL ranks to announce — if the
+  // ERROR response fired early, ranks that enqueue late would miss it and
+  // hang forever waiting for peers that already errored out (the reference
+  // likewise constructs responses only once the count completes,
+  // operations.cc:315-517).
   std::vector<std::string> remaining;
   remaining.reserve(fifo_.size());
   for (const auto& name : fifo_) {
     auto it = table_.find(name);
     if (it == table_.end()) continue;
     TensorRecord& rec = it->second;
-    if (rec.ready_count >= size_ || !rec.error.empty()) {
+    if (rec.ready_count >= size_) {
       out.responses.push_back(Finalize(name));
       table_.erase(it);
     } else {

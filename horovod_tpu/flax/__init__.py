@@ -1,0 +1,79 @@
+"""Flax binding — the Keras-binding analog for the JAX ecosystem.
+
+The reference ships Keras façades over its TF core (reference
+horovod/keras/__init__.py, keras/_impl.py, tensorflow/keras/__init__.py):
+``DistributedOptimizer``, callbacks, and ``load_model`` that re-wraps saved
+optimizers.  Flax is the idiomatic high-level layer on JAX, so this module
+is that façade: TrainState helpers that bundle model/params/optimizer with
+the distributed wrapper applied, plus save/load that re-applies the wrapper
+on restore (the ``hvd.load_model`` contract, keras/__init__.py:115-148).
+"""
+
+from __future__ import annotations
+
+import jax
+import optax
+from flax.training import train_state
+
+from horovod_tpu import basics, checkpoint, training
+from horovod_tpu.callbacks import (  # noqa: F401 - re-export, keras parity
+    BroadcastGlobalVariablesCallback,
+    LearningRateScheduleCallback,
+    LearningRateWarmupCallback,
+    MetricAverageCallback,
+)
+from horovod_tpu.ops.compression import Compression  # noqa: F401
+
+
+def DistributedOptimizer(optimizer: optax.GradientTransformation,
+                         **kwargs) -> optax.GradientTransformation:
+    """Keras-parity alias (reference keras/__init__.py:34-56)."""
+    return training.DistributedOptimizer(optimizer, **kwargs)
+
+
+class TrainState(train_state.TrainState):
+    """flax TrainState whose ``tx`` is always distributed."""
+
+    @classmethod
+    def create_distributed(cls, *, apply_fn, params,
+                           tx: optax.GradientTransformation,
+                           compression=Compression.none, **kwargs):
+        """Create a state with gradient averaging applied (the analog of
+        ``create_distributed_optimizer``, reference keras/_impl.py:20-33)."""
+        dtx = training.DistributedOptimizer(tx, compression=compression)
+        return cls.create(apply_fn=apply_fn, params=params, tx=dtx, **kwargs)
+
+
+def save_model(path, state: train_state.TrainState) -> None:
+    """Rank-0 checkpoint of params + opt_state + step (reference Keras
+    ``ModelCheckpoint``-on-rank-0 contract)."""
+    checkpoint.save(path, {"params": state.params,
+                           "opt_state": state.opt_state,
+                           "step": state.step})
+
+
+def load_model(path, *, apply_fn, tx: optax.GradientTransformation,
+               compression=Compression.none) -> TrainState:
+    """Restore and RE-WRAP: the stored optimizer state is loaded into a
+    freshly distributed-wrapped ``tx`` and broadcast, mirroring
+    ``hvd.load_model``'s custom_objects re-wrapping (reference
+    keras/__init__.py:115-148) and broadcast-after-load consistency."""
+    raw = checkpoint.restore(path, broadcast=False)
+    state = TrainState.create_distributed(
+        apply_fn=apply_fn, params=raw["params"], tx=tx,
+        compression=compression)
+    state = state.replace(step=raw["step"])
+    try:
+        state = state.replace(
+            opt_state=jax.tree.unflatten(
+                jax.tree.structure(state.opt_state),
+                jax.tree.leaves(raw["opt_state"])))
+    except (ValueError, TypeError, KeyError):
+        # Optimizer hyperparameters changed shape — keep fresh opt state,
+        # params still restored (same leniency as Keras custom_objects path).
+        pass
+    if basics.size() > 1:
+        state = state.replace(
+            params=training.broadcast_parameters(state.params),
+            opt_state=training.broadcast_optimizer_state(state.opt_state))
+    return state

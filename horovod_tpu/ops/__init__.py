@@ -9,6 +9,10 @@ from horovod_tpu.ops.collective_ops import (  # noqa: F401
     sparse_to_dense,
 )
 from horovod_tpu.ops.compression import Compression  # noqa: F401
+from horovod_tpu.ops.flash_attention import (  # noqa: F401
+    flash_attention,
+    make_flash_attention,
+)
 from horovod_tpu.ops.async_ops import (  # noqa: F401
     allgather_async,
     allreduce_async,

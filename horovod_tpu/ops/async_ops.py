@@ -84,8 +84,17 @@ def synchronize(handle: int):
     torch/mpi_ops.py:422-438)."""
     eng = engine_mod.get_engine()
     with _meta_lock:
-        meta = _meta.pop(handle, {})
-    out = eng.synchronize(handle)
+        meta = _meta.get(handle, {})
+    try:
+        out = eng.synchronize(handle)
+    except TimeoutError:
+        raise  # handle still live — metadata kept so a retry works
+    except Exception:
+        with _meta_lock:
+            _meta.pop(handle, None)
+        raise
+    with _meta_lock:
+        _meta.pop(handle, None)
     if out is None:
         return None
     if meta.get("average"):

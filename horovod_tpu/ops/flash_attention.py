@@ -1,0 +1,195 @@
+"""Fused flash attention — Pallas TPU kernel for the attention hot op.
+
+The reference has no attention code (SURVEY §2.9); this kernel exists because
+the task's long-context path must not materialize S×S logits.  Dense
+attention (models/transformer.py) is O(S²) HBM; this kernel streams K/V
+blocks through VMEM with an online softmax, so HBM traffic is O(S·D) and the
+block matmuls run back-to-back on the MXU — the standard flash-attention
+scheme expressed as a Pallas grid over (batch·heads, query-blocks).
+
+Integration points:
+* ``make_flash_attention()`` → drop-in ``TransformerConfig.attention_fn``.
+* ``parallel/ring_attention.py`` can use it per ring step (each step is
+  exactly one q-block × local-K/V attention with carried (m, l, acc)).
+
+Backward runs via recomputation with the reference einsum implementation
+(O(S²) transient in the cotangent pass only) under ``jax.custom_vjp`` — a
+fused backward kernel is a further optimization, the forward is where
+inference/serving and activation memory win.
+
+Non-TPU backends fall back to Pallas interpret mode (tests) so numerics are
+identical everywhere.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+try:  # TPU-specific memory spaces; absent on some CPU-only builds
+    from jax.experimental.pallas import tpu as pltpu
+    _SMEM = pltpu.SMEM
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _SMEM = None
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(meta_ref, q_ref, k_ref, v_ref, o_ref, *,
+                  block_q: int, block_k: int, num_k_blocks: int,
+                  causal: bool, scale: float):
+    """One (batch·head, q-block) program: stream K/V blocks, online softmax.
+
+    meta_ref (SMEM int32[3]): [q_offset, k_offset, k_len] — global position
+    offsets (sequence parallelism) and the unpadded K length.
+    """
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale              # [bq, D]
+    d = q.shape[-1]
+    q_pos = (meta_ref[0] + qi * block_q
+             + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0))
+
+    def body(ki, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(ki * block_k, block_k), :]     # [bk, D]
+        v = v_ref[0, pl.ds(ki * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [bq, bk]
+        k_pos = (meta_ref[1] + ki * block_k
+                 + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1))
+        mask = k_pos < meta_ref[2]                        # padding mask
+        if causal:
+            mask = jnp.logical_and(mask, q_pos >= k_pos)
+        s = jnp.where(mask, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        p = jnp.where(mask, p, 0.0)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc * corr + pv
+
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    _, l, acc = jax.lax.fori_loop(0, num_k_blocks, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _pad_to(x, axis, multiple):
+    n = x.shape[axis]
+    pad = (-n) % multiple
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _flash_forward(q, k, v, causal, q_offset, k_offset, block_q, block_k,
+                   interpret):
+    b, s_q, h, d = q.shape
+    s_k = k.shape[1]
+    scale = d ** -0.5
+    # [B, S, H, D] → [B·H, S, D]
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+    qb = _pad_to(to_bh(q), 1, block_q)
+    kb = _pad_to(to_bh(k), 1, block_k)
+    vb = _pad_to(to_bh(v), 1, block_k)
+    num_q_blocks = qb.shape[1] // block_q
+    num_k_blocks = kb.shape[1] // block_k
+    meta = jnp.asarray(
+        [jnp.asarray(q_offset, jnp.int32),
+         jnp.asarray(k_offset, jnp.int32),
+         jnp.asarray(k_offset, jnp.int32) + s_k], jnp.int32)
+
+    kernel = functools.partial(
+        _flash_kernel, block_q=block_q, block_k=block_k,
+        num_k_blocks=num_k_blocks, causal=causal, scale=scale)
+    smem = {"memory_space": _SMEM} if _SMEM is not None else {}
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, num_q_blocks),
+        in_specs=[
+            pl.BlockSpec((3,), lambda bh, qi: (0,), **smem),
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, kb.shape[1], d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, kb.shape[1], d), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct(qb.shape, q.dtype),
+        interpret=interpret,
+    )(meta, qb, kb, vb)
+    out = out[:, :s_q].reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
+    return out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 6, 7, 8))
+def _flash(q, k, v, causal, q_offset, k_offset, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, causal, q_offset, k_offset, block_q,
+                          block_k, interpret)
+
+
+def _reference(q, k, v, causal, q_offset, k_offset):
+    """Einsum attention with global-position causal masking (matches the
+    kernel's semantics; used for the recompute backward)."""
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    q_pos = q_offset + jnp.arange(q.shape[1])[:, None]
+    k_pos = k_offset + jnp.arange(k.shape[1])[None, :]
+    if causal:
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _flash_fwd(q, k, v, causal, q_offset, k_offset, block_q, block_k,
+               interpret):
+    out = _flash_forward(q, k, v, causal, q_offset, k_offset, block_q,
+                         block_k, interpret)
+    return out, (q, k, v, q_offset, k_offset)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v, q_offset, k_offset = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: _reference(q, k, v, causal, q_offset, k_offset),
+        q, k, v)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, None, None
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = True, q_offset=0, k_offset=0,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: bool | None = None):
+    """Fused attention over [B, S, H, D] tensors.
+
+    ``q_offset``/``k_offset`` are global sequence positions of the first
+    row/col (sequence-parallel shards pass shard_index × shard_len).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    block_q = min(block_q, max(q.shape[1], 1))
+    block_k = min(block_k, max(k.shape[1], 1))
+    return _flash(q, k, v, causal, q_offset, k_offset, block_q, block_k,
+                  interpret)
+
+
+def make_flash_attention(block_q: int = 128, block_k: int = 128):
+    """Adapter producing a ``TransformerConfig.attention_fn``."""
+    def attn(q, k, v, causal=True):
+        return flash_attention(q, k, v, causal=causal, block_q=block_q,
+                               block_k=block_k)
+    return attn

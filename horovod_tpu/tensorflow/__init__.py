@@ -1,0 +1,36 @@
+"""TensorFlow binding — gated (TensorFlow is not in this environment).
+
+The reference's largest binding is TensorFlow (reference
+horovod/tensorflow/*); this image ships no TensorFlow, so rather than a
+silent ImportError users get the reference's actionable ``check_extension``
+behaviour (reference common/__init__.py:43-48): a clear message naming the
+equivalent APIs.  Every public symbol of the reference TF surface is listed
+so ``from horovod_tpu.tensorflow import DistributedOptimizer`` fails with
+guidance instead of AttributeError.
+"""
+
+from __future__ import annotations
+
+_MESSAGE = (
+    "horovod_tpu was built for the JAX/TPU stack; TensorFlow is not "
+    "available in this environment. Equivalent APIs: "
+    "horovod_tpu.DistributedOptimizer (optax), "
+    "horovod_tpu.flax (Keras-style facade: TrainState/load_model/callbacks), "
+    "horovod_tpu.torch (eager binding), "
+    "hvd.broadcast_parameters (BroadcastGlobalVariablesHook), "
+    "hvd.allreduce/allgather/broadcast (tf ops)."
+)
+
+_TF_SURFACE = [
+    # reference tensorflow/__init__.py + mpi_ops.py exports
+    "DistributedOptimizer", "BroadcastGlobalVariablesHook",
+    "broadcast_global_variables", "allreduce", "allgather", "broadcast",
+    "init", "shutdown", "size", "local_size", "rank", "local_rank",
+    "mpi_threads_supported", "Compression",
+]
+
+
+def __getattr__(name):
+    if name in _TF_SURFACE:
+        raise NotImplementedError(_MESSAGE)
+    raise AttributeError(name)

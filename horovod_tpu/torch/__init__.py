@@ -1,0 +1,56 @@
+"""PyTorch binding — the reference's ``horovod.torch`` surface on the TPU
+runtime.
+
+Rebuild of reference horovod/torch/__init__.py + mpi_ops.py: the same eager
+API (``hvd.allreduce(_async)(_)``, ``poll``/``synchronize``,
+``DistributedOptimizer`` with gradient hooks, ``broadcast_parameters``,
+``broadcast_optimizer_state``) driven by the native coordination engine
+(core/) instead of the MPI/NCCL background thread.  Torch stays the host
+framework (CPU tensors in this image); the engine negotiates cross-process
+readiness and fuses, and the executor moves bytes over the JAX process
+collectives — torch itself never needs a distributed backend.
+
+Usage (identical to reference README.md:203-249)::
+
+    import horovod_tpu.torch as hvd
+    hvd.init()
+    optimizer = hvd.DistributedOptimizer(optimizer,
+                                         named_parameters=model.named_parameters())
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+"""
+
+from __future__ import annotations
+
+from horovod_tpu.basics import (  # noqa: F401
+    cross_rank,
+    cross_size,
+    init,
+    is_initialized,
+    local_rank,
+    local_size,
+    mpi_threads_supported,
+    rank,
+    shutdown,
+    size,
+)
+from horovod_tpu.torch.compression import Compression  # noqa: F401
+from horovod_tpu.torch.mpi_ops import (  # noqa: F401
+    allgather,
+    allgather_async,
+    allreduce,
+    allreduce_,
+    allreduce_async,
+    allreduce_async_,
+    broadcast,
+    broadcast_,
+    broadcast_async,
+    broadcast_async_,
+    poll,
+    synchronize,
+)
+from horovod_tpu.torch.optimizer import DistributedOptimizer  # noqa: F401
+from horovod_tpu.torch.state import (  # noqa: F401
+    broadcast_object,
+    broadcast_optimizer_state,
+    broadcast_parameters,
+)

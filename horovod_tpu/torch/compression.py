@@ -1,0 +1,61 @@
+"""Gradient compression for torch tensors.
+
+Reference horovod/torch/compression.py:24-74 verbatim in behaviour:
+``Compression.none`` / ``Compression.fp16`` cast floating tensors to half for
+the wire and back after; plus ``Compression.bf16`` (TPU-native wire format,
+not in the reference)."""
+
+from __future__ import annotations
+
+import torch
+
+
+class Compressor:
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class _CastCompressor(Compressor):
+    wire_dtype: torch.dtype
+
+    @classmethod
+    def compress(cls, tensor):
+        ctx = tensor.dtype
+        if tensor.is_floating_point() and ctx != cls.wire_dtype:
+            return tensor.to(cls.wire_dtype), ctx
+        return tensor, ctx
+
+    @classmethod
+    def decompress(cls, tensor, ctx):
+        if ctx is not None and tensor.dtype != ctx:
+            return tensor.to(ctx)
+        return tensor
+
+
+class FP16Compressor(_CastCompressor):
+    wire_dtype = torch.float16
+
+
+class BF16Compressor(_CastCompressor):
+    wire_dtype = torch.bfloat16
+
+
+class Compression:
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

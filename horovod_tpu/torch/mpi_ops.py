@@ -1,0 +1,173 @@
+"""Torch tensor collectives over the native engine.
+
+Rebuild of reference horovod/torch/mpi_ops.py (+ the C++ shims
+mpi_ops_v2.cc / adapter_v2.cc it drives): sync and async variants, in-place
+``_`` forms, ``poll``/``synchronize``.  Instead of per-dtype C++ kernels and
+a CUDA-staging path, tensors cross into the engine as numpy views — zero-copy
+for all natively-numpy dtypes; float16 is numpy-native, and bfloat16 moves as
+an ml_dtypes view (bit-exact), exercising the engine's bf16 wire type.
+
+Autograd: ``allreduce`` is differentiable — grad(allreduce) = allreduce
+(reference mpi_ops.py:110-121) via a torch.autograd.Function.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+import torch
+
+from horovod_tpu import basics
+from horovod_tpu.core import engine as engine_mod
+from horovod_tpu.torch.compression import Compression
+
+_counter = itertools.count()
+# handle → metadata needed at synchronize time
+_handles: dict[int, dict] = {}
+
+
+def _auto_name(prefix: str, name: str | None) -> str:
+    return name if name is not None else f"{prefix}.noname.{next(_counter)}"
+
+
+def _to_numpy(t: torch.Tensor) -> np.ndarray:
+    t = t.detach().contiguous()
+    if t.dtype == torch.bfloat16:
+        import ml_dtypes
+
+        return t.view(torch.int16).numpy().view(ml_dtypes.bfloat16)
+    return t.numpy()
+
+
+def _to_torch(a: np.ndarray, like: torch.Tensor) -> torch.Tensor:
+    if a.dtype.name == "bfloat16":
+        out = torch.from_numpy(a.view(np.int16).copy()).view(torch.bfloat16)
+    else:
+        out = torch.from_numpy(np.ascontiguousarray(a))
+    return out.to(like.dtype) if out.dtype != like.dtype else out
+
+
+def _enqueue(prefix, tensor, op, name, root_rank=-1, average=False,
+             compression=Compression.none, inplace_into=None) -> int:
+    eng = engine_mod.get_engine()
+    compressed, ctx = compression.compress(tensor)
+    h = eng.enqueue(_auto_name(prefix, name), _to_numpy(compressed), op,
+                    root_rank=root_rank)
+    _handles[h] = {"average": average, "compression": compression,
+                   "ctx": ctx, "template": tensor,
+                   "inplace_into": inplace_into}
+    return h
+
+
+def synchronize(handle: int) -> torch.Tensor:
+    """Block until the async op completes; returns (and for ``_`` variants,
+    writes back) the result (reference mpi_ops.py:422-438)."""
+    eng = engine_mod.get_engine()
+    meta = _handles[handle]
+    try:
+        out_np = eng.synchronize(handle)
+    except TimeoutError:
+        raise  # handle still live — keep metadata so a retry works
+    except Exception:
+        _handles.pop(handle, None)
+        raise
+    _handles.pop(handle, None)
+    out = _to_torch(out_np, meta["template"])
+    if meta["average"]:
+        out = out / basics.size() if out.is_floating_point() \
+            else torch.div(out, basics.size(), rounding_mode="trunc")
+    out = meta["compression"].decompress(out, meta["ctx"])
+    target = meta["inplace_into"]
+    if target is not None:
+        with torch.no_grad():
+            target.resize_(out.shape).copy_(out)
+        return target
+    return out
+
+
+def poll(handle: int) -> bool:
+    """True once ``synchronize`` will not block (reference mpi_ops.py:408-419)."""
+    return engine_mod.get_engine().poll(handle)
+
+
+# -- allreduce --------------------------------------------------------------
+
+class _AllreduceFunction(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, average, name, compression):
+        ctx.average = average
+        ctx.name = name
+        h = _enqueue("allreduce", tensor, engine_mod.OP_ALLREDUCE, name,
+                     average=average, compression=compression)
+        return synchronize(h)
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        # grad(allreduce) = allreduce (reference mpi_ops.py:110-121).
+        return allreduce(grad_output, average=ctx.average), None, None, None
+
+
+def allreduce(tensor: torch.Tensor, average: bool = True,
+              name: str | None = None,
+              compression=Compression.none) -> torch.Tensor:
+    """Synchronous, differentiable allreduce (reference mpi_ops.py:86-121)."""
+    if tensor.requires_grad:
+        return _AllreduceFunction.apply(tensor, average, name, compression)
+    return synchronize(allreduce_async(tensor, average, name, compression))
+
+
+def allreduce_async(tensor: torch.Tensor, average: bool = True,
+                    name: str | None = None,
+                    compression=Compression.none) -> int:
+    return _enqueue("allreduce", tensor, engine_mod.OP_ALLREDUCE, name,
+                    average=average, compression=compression)
+
+
+def allreduce_(tensor: torch.Tensor, average: bool = True,
+               name: str | None = None) -> torch.Tensor:
+    """In-place allreduce (reference mpi_ops.py:156-174)."""
+    return synchronize(allreduce_async_(tensor, average, name))
+
+
+def allreduce_async_(tensor: torch.Tensor, average: bool = True,
+                     name: str | None = None) -> int:
+    return _enqueue("allreduce", tensor, engine_mod.OP_ALLREDUCE, name,
+                    average=average, inplace_into=tensor)
+
+
+# -- allgather --------------------------------------------------------------
+
+def allgather(tensor: torch.Tensor, name: str | None = None) -> torch.Tensor:
+    """Concatenate along dim 0 across ranks; dim-0 sizes may differ per rank
+    (reference mpi_ops.py:228-307)."""
+    return synchronize(allgather_async(tensor, name))
+
+
+def allgather_async(tensor: torch.Tensor, name: str | None = None) -> int:
+    return _enqueue("allgather", tensor, engine_mod.OP_ALLGATHER, name)
+
+
+# -- broadcast --------------------------------------------------------------
+
+def broadcast(tensor: torch.Tensor, root_rank: int,
+              name: str | None = None) -> torch.Tensor:
+    """Synchronous broadcast from ``root_rank`` (reference mpi_ops.py:310-345)."""
+    return synchronize(broadcast_async(tensor, root_rank, name))
+
+
+def broadcast_async(tensor: torch.Tensor, root_rank: int,
+                    name: str | None = None) -> int:
+    return _enqueue("broadcast", tensor, engine_mod.OP_BROADCAST, name,
+                    root_rank=root_rank)
+
+
+def broadcast_(tensor: torch.Tensor, root_rank: int,
+               name: str | None = None) -> torch.Tensor:
+    return synchronize(broadcast_async_(tensor, root_rank, name))
+
+
+def broadcast_async_(tensor: torch.Tensor, root_rank: int,
+                     name: str | None = None) -> int:
+    return _enqueue("broadcast", tensor, engine_mod.OP_BROADCAST, name,
+                    root_rank=root_rank, inplace_into=tensor)

@@ -1,0 +1,120 @@
+"""DistributedOptimizer for torch — allreduce-in-backward.
+
+Rebuild of reference horovod/torch/__init__.py:42-150: wraps any torch
+optimizer; a post-accumulate-grad hook per parameter fires
+``allreduce_async`` the moment that parameter's gradient is ready, so
+communication overlaps the rest of backward (the reference registers hooks
+on the gradient accumulator nodes, :72-81 — modern torch exposes
+``register_post_accumulate_grad_hook`` for exactly this); ``step()`` drains
+the handles then applies the base optimizer.  The engine fuses whatever
+handles land in the same cycle (the reference fusion-buffer win)."""
+
+from __future__ import annotations
+
+import torch
+
+from horovod_tpu.torch import mpi_ops
+from horovod_tpu.torch.compression import Compression
+
+
+def DistributedOptimizer(optimizer: torch.optim.Optimizer,
+                         named_parameters=None,
+                         compression=Compression.none,
+                         backward_passes_per_step: int = 1):
+    """Wrap ``optimizer`` so ``step()`` applies globally averaged gradients
+    (reference torch/__init__.py:119-150 factory)."""
+    return _DistributedOptimizer(optimizer, named_parameters, compression,
+                                 backward_passes_per_step)
+
+
+class _DistributedOptimizer:
+    """Proxy over the base optimizer (same effect as the reference's dynamic
+    subclass, torch/__init__.py:140-147, without the metaclass gymnastics)."""
+
+    def __init__(self, optimizer, named_parameters, compression,
+                 backward_passes_per_step):
+        self._opt = optimizer
+        self._compression = compression
+        self._bpps = max(backward_passes_per_step, 1)
+        self._accum: dict[int, int] = {}          # id(param) → hook fires seen
+        self._handles: dict[torch.nn.Parameter, tuple[int, object]] = {}
+        self._hook_removers = []
+
+        if named_parameters is not None:
+            named = list(named_parameters)
+        else:
+            named = [(f"param.{i}.{j}", p)
+                     for i, g in enumerate(optimizer.param_groups)
+                     for j, p in enumerate(g["params"])]
+        names = [n for n, _ in named]
+        if len(set(names)) != len(names):
+            # Reference duplicate-name check, torch/__init__.py:56-64.
+            raise ValueError("named_parameters contains duplicate names")
+        params_in_opt = {id(p) for g in optimizer.param_groups
+                         for p in g["params"]}
+        for name, p in named:
+            if id(p) not in params_in_opt or not p.requires_grad:
+                continue
+            self._hook_removers.append(
+                p.register_post_accumulate_grad_hook(self._make_hook(name)))
+
+    def _make_hook(self, name):
+        def hook(p):
+            # Gradient accumulation: only allreduce on the final backward of
+            # the accumulation window (reference backward_passes_per_step).
+            seen = self._accum.get(id(p), 0) + 1
+            if seen < self._bpps:
+                self._accum[id(p)] = seen
+                return
+            self._accum[id(p)] = 0
+            if p in self._handles:
+                # Reference guard against double-allreduce before step()
+                # (torch/__init__.py:91-97).
+                raise AssertionError(
+                    f"Gradient for {name} was allreduced twice before "
+                    f"step(); for gradient accumulation pass "
+                    f"backward_passes_per_step.")
+            compressed, ctx = self._compression.compress(p.grad)
+            h = mpi_ops.allreduce_async(compressed, average=True,
+                                        name=f"DistributedOptimizer.{name}")
+            self._handles[p] = (h, ctx)
+        return hook
+
+    def synchronize(self):
+        """Drain outstanding allreduces into ``.grad`` (reference
+        torch/__init__.py:99-108)."""
+        for p, (h, ctx) in list(self._handles.items()):
+            out = self._compression.decompress(mpi_ops.synchronize(h), ctx)
+            with torch.no_grad():
+                p.grad.copy_(out)
+        self._handles.clear()
+
+    def step(self, closure=None):
+        # step() without outstanding handles (e.g. no backward ran) must not
+        # deadlock — reference test_force_allreduce (test_torch.py:972+).
+        self.synchronize()
+        return self._opt.step(closure)
+
+    # -- delegate everything else to the wrapped optimizer ------------------
+    def zero_grad(self, *a, **k):
+        return self._opt.zero_grad(*a, **k)
+
+    @property
+    def param_groups(self):
+        return self._opt.param_groups
+
+    @property
+    def state(self):
+        return self._opt.state
+
+    def state_dict(self):
+        return self._opt.state_dict()
+
+    def load_state_dict(self, sd):
+        return self._opt.load_state_dict(sd)
+
+    def add_param_group(self, g):
+        return self._opt.add_param_group(g)
+
+    def __repr__(self):
+        return f"DistributedOptimizer({self._opt!r})"

@@ -1,0 +1,28 @@
+"""Checkpoint contract tests: rank-0 writes, restore + broadcast, epoch
+resume (reference contract per SURVEY §5 checkpoint/resume)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from horovod_tpu import checkpoint
+
+
+def test_save_restore_roundtrip(hvd, tmp_path):
+    state = {"params": {"w": jnp.arange(6.0).reshape(2, 3)},
+             "step": jnp.array(7)}
+    p = tmp_path / "ckpt"
+    checkpoint.save(p, state)
+    assert checkpoint.exists(p)
+    out = checkpoint.restore(p)
+    np.testing.assert_array_equal(out["params"]["w"], state["params"]["w"])
+    assert int(out["step"]) == 7
+
+
+def test_epoch_resume(hvd, tmp_path):
+    base = tmp_path / "run"
+    assert checkpoint.resume_epoch(base) == 0
+    checkpoint.save_epoch(base, 1, {"w": jnp.ones(3)})
+    checkpoint.save_epoch(base, 3, {"w": jnp.ones(3) * 3})
+    assert checkpoint.resume_epoch(base) == 3
+    out = checkpoint.restore_epoch(base, 3)
+    np.testing.assert_array_equal(out["w"], np.ones(3) * 3)

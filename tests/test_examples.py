@@ -1,0 +1,61 @@
+"""Example smoke tests — the reference runs its examples end-to-end in CI
+(.travis.yml:113-131, shrunk via sed); we do the same with tiny arguments
+on the virtual 8-chip mesh."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script, *args, timeout=420):
+    env = {
+        **os.environ,
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO,
+    }
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", script), *args],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO)
+    assert out.returncode == 0, out.stderr[-3000:]
+    return out.stdout
+
+
+def test_jax_mnist(tmp_path):
+    out = _run("jax_mnist.py", "--epochs", "1", "--batch-size", "4",
+               "--ckpt-dir", str(tmp_path / "ck"))
+    assert "epoch 0" in out and "loss=" in out
+
+
+def test_jax_mnist_advanced():
+    out = _run("jax_mnist_advanced.py")
+    assert "finished gradual learning rate warmup" in out
+
+
+def test_torch_mnist():
+    out = _run("torch_mnist.py", "--epochs", "1")
+    assert "epoch 0" in out
+
+
+def test_jax_word2vec():
+    out = _run("jax_word2vec.py", "--steps", "5", "--vocab", "500",
+               "--dim", "32")
+    assert "step 0" in out
+
+
+def test_jax_longseq_transformer():
+    out = _run("jax_longseq_transformer.py", "--seq-len", "512", "--layers",
+               "1", "--heads", "4", "--embed", "64", "--steps", "1")
+    assert "step 0" in out
+
+
+@pytest.mark.slow
+def test_jax_imagenet_resnet50(tmp_path):
+    out = _run("jax_imagenet_resnet50.py", "--epochs", "1",
+               "--steps-per-epoch", "1", "--batch-size", "1",
+               "--ckpt-dir", str(tmp_path / "r50"), timeout=560)
+    assert "epoch 0" in out
