@@ -67,9 +67,10 @@ def main():
     opt_state = opt.init(params)
 
     # Resume (reference :63-72): rank 0 lists checkpoints, the resume epoch
-    # is broadcast, state restored + broadcast.
+    # is broadcast, state restored + broadcast.  resume_epoch returns the
+    # last COMPLETED epoch (-1 when fresh); training continues at resume+1.
     resume = hvd.checkpoint.resume_epoch(args.ckpt_dir)
-    if resume:
+    if resume >= 0:
         restored = hvd.checkpoint.restore_epoch(
             args.ckpt_dir, resume,
             {"params": params, "batch_stats": batch_stats})
@@ -100,7 +101,7 @@ def main():
     gb = args.batch_size * size
     rng_np = np.random.RandomState(hvd.rank())
 
-    for epoch in range(resume, args.epochs):
+    for epoch in range(resume + 1, args.epochs):
         t0 = time.time()
         loss = None
         for _ in range(spe):

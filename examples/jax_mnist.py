@@ -78,6 +78,10 @@ def main():
     x_all = x_all[hvd.rank()::hvd.size()]
     y_all = y_all[hvd.rank()::hvd.size()]
     steps = len(x_all) // global_batch
+    if steps == 0:
+        raise SystemExit(
+            f"per-process shard ({len(x_all)} rows) is smaller than the "
+            f"global batch ({global_batch}); lower --batch-size or add data.")
 
     for epoch in range(args.epochs):
         t0 = time.time()

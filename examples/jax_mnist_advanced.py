@@ -72,8 +72,10 @@ def main():
         updates, opt_state = opt.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
-    x_all, y_all = synthetic_mnist(2048)
     gb = 32 * hvd.num_chips()
+    # Enough rows that the rolling window below always has room (covers
+    # large pod slices where 32×num_chips would exceed a fixed 2048).
+    x_all, y_all = synthetic_mnist(max(2048, 4 * gb))
 
     state = hvd.callbacks.run_callbacks(callbacks, "on_train_begin", state)
     for epoch in range(epochs):

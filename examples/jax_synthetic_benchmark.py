@@ -66,16 +66,21 @@ def run(args, threshold: int | None = None) -> float:
                                                     opt_state, x, y)
         return loss
 
+    loss = None
     for _ in range(args.num_warmup_batches):
         loss = one()
-    jax.block_until_ready(loss)
+    if loss is not None:
+        float(loss)  # hard sync via host fetch
 
+    # Each timed window closes with a host fetch — bare block_until_ready
+    # returns early on tunneled backends and over-reports throughput
+    # (docs/benchmarks.md methodology; same guard as bench.py).
     img_secs = []
     for _ in range(args.num_iters):
         t0 = time.time()
         for _ in range(args.num_batches_per_iter):
             loss = one()
-        jax.block_until_ready(loss)
+        float(loss)
         img_secs.append(gb * args.num_batches_per_iter / (time.time() - t0))
 
     img_sec_mean = np.mean(img_secs)

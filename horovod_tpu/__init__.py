@@ -48,6 +48,7 @@ from horovod_tpu.ops import (  # noqa: F401
     allreduce,
     allreduce_async,
     allreduce_sparse,
+    barrier,
     batch_spec,
     broadcast,
     broadcast_async,

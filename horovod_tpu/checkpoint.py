@@ -36,18 +36,29 @@ def save(path: str | os.PathLike, state: Any, *, force: bool = True) -> None:
 def restore(path: str | os.PathLike, template: Any | None = None,
             *, broadcast: bool = True, root_rank: int = 0) -> Any:
     """Load a checkpoint and (by default) broadcast it from ``root_rank`` so
-    every worker resumes identically — the reference's resume contract."""
-    import orbax.checkpoint as ocp
+    every worker resumes identically — the reference's resume contract.
 
-    path = os.path.abspath(os.fspath(path))
-    with ocp.PyTreeCheckpointer() as ckptr:
-        if template is not None:
-            state = ckptr.restore(path, ocp.args.PyTreeRestore(template))
-        else:
-            state = ckptr.restore(path)
-    if broadcast and basics.size() > 1:
-        state = training.broadcast_parameters(state, root_rank=root_rank)
-    return state
+    Only ``root_rank`` touches the filesystem (matching ``resume_epoch``'s
+    stale-filesystem assumption): with a ``template``, other ranks receive
+    the arrays via collective broadcast; without one, the whole tree moves
+    as one object broadcast.
+    """
+    def read():
+        import orbax.checkpoint as ocp
+
+        p = os.path.abspath(os.fspath(path))
+        with ocp.PyTreeCheckpointer() as ckptr:
+            if template is not None:
+                return ckptr.restore(p, ocp.args.PyTreeRestore(template))
+            return ckptr.restore(p)
+
+    if basics.size() == 1 or not broadcast:
+        return read()
+    if template is not None:
+        local = read() if basics.rank() == root_rank else template
+        return training.broadcast_parameters(local, root_rank=root_rank)
+    state = read() if basics.rank() == root_rank else None
+    return training.broadcast_object(state, root_rank=root_rank)
 
 
 def exists(path: str | os.PathLike) -> bool:
@@ -55,12 +66,13 @@ def exists(path: str | os.PathLike) -> bool:
 
 
 def resume_epoch(path: str | os.PathLike, root_rank: int = 0) -> int:
-    """Broadcast rank 0's view of the last finished epoch (the reference
-    broadcasts a ``resume_from_epoch`` scalar,
-    examples/pytorch_imagenet_resnet50.py:63-72): checkpoints are saved under
-    ``path/epoch_<N>``; workers may see stale filesystems, so only rank 0
-    lists."""
-    epoch = 0
+    """Broadcast rank 0's view of the last finished epoch, or **-1 when no
+    checkpoint exists** (so a saved epoch 0 is distinguishable from a fresh
+    start).  The reference broadcasts a ``resume_from_epoch`` scalar the
+    same way (examples/pytorch_imagenet_resnet50.py:63-72).  Checkpoints are
+    saved under ``path/epoch_<N>``; workers may see stale filesystems, so
+    only rank 0 lists."""
+    epoch = -1
     if basics.rank() == root_rank and os.path.isdir(os.fspath(path)):
         for entry in os.listdir(os.fspath(path)):
             if entry.startswith("epoch_"):

@@ -34,7 +34,9 @@ import numpy as np
 from horovod_tpu.utils import env
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_LIB_PATH = os.path.join(_HERE, "libhvdcore.so")
+# HVD_CORE_LIB selects an alternate build (e.g. libhvdcore_tsan.so).
+_LIB_PATH = os.path.join(_HERE, os.environ.get("HVD_CORE_LIB",
+                                               "libhvdcore.so"))
 
 # Wire enums — must match core/src/common.h and message.h.
 OP_ALLREDUCE, OP_ALLGATHER, OP_BROADCAST, OP_ALLTOALL, OP_BARRIER = range(5)

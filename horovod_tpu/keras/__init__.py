@@ -1,0 +1,30 @@
+"""Keras-name compatibility alias.
+
+Users of the reference import ``horovod.keras`` (reference
+horovod/keras/__init__.py); the JAX-ecosystem equivalent of Keras here is
+flax, so this module re-exports the flax façade under the familiar name —
+``DistributedOptimizer``, callbacks, ``load_model``/``save_model`` — plus
+the process API.
+"""
+
+from horovod_tpu.basics import (  # noqa: F401
+    init,
+    local_rank,
+    local_size,
+    mpi_threads_supported,
+    rank,
+    shutdown,
+    size,
+)
+from horovod_tpu.flax import (  # noqa: F401
+    BroadcastGlobalVariablesCallback,
+    Compression,
+    DistributedOptimizer,
+    LearningRateScheduleCallback,
+    LearningRateWarmupCallback,
+    MetricAverageCallback,
+    TrainState,
+    load_model,
+    save_model,
+)
+from horovod_tpu.ops import allgather, allreduce, broadcast  # noqa: F401

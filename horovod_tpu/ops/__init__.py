@@ -16,6 +16,7 @@ from horovod_tpu.ops.flash_attention import (  # noqa: F401
 from horovod_tpu.ops.async_ops import (  # noqa: F401
     allgather_async,
     allreduce_async,
+    barrier,
     broadcast_async,
     poll,
     synchronize,

@@ -73,6 +73,20 @@ def broadcast_async(tensor, root_rank: int, name: str | None = None) -> int:
     return h
 
 
+def barrier(name: str | None = None) -> None:
+    """Block until every process reaches the barrier.
+
+    Not in the reference (its shutdown/negotiation are implicitly
+    barrier-like); provided because eager multi-host flows need one (e.g.
+    "rank 0 wrote the checkpoint, everyone may now read").  Implemented as a
+    zero-payload negotiated op, so it rides the same coordinator.
+    """
+    eng = engine_mod.get_engine()
+    h = eng.enqueue(_auto_name("barrier", name), np.zeros((1,), np.uint8),
+                    engine_mod.OP_BARRIER)
+    eng.synchronize(h)
+
+
 def poll(handle: int) -> bool:
     """True if the collective behind ``handle`` has completed (reference
     torch/mpi_ops.py:408-419)."""

@@ -17,3 +17,8 @@ from horovod_tpu.parallel.ulysses import (  # noqa: F401
     ulysses_attention,
     make_ulysses_attention,
 )
+from horovod_tpu.parallel.tensor_parallel import (  # noqa: F401
+    ColumnParallelDense,
+    ParallelMLP,
+    RowParallelDense,
+)

@@ -1,0 +1,98 @@
+"""Tensor parallelism building blocks — Megatron-style sharded layers.
+
+Beyond reference scope (SURVEY §2.9: the reference is DP-only) but the mesh
+design must not preclude TP, and these modules prove it does not: pass
+``mesh_axes={"tp": K}`` to ``init()`` and the global mesh grows a ``tp``
+axis next to the data axes; these flax modules shard their weights over it
+inside ``shard_map``.
+
+The canonical pair (one all-reduce per MLP/attention block, like Megatron):
+
+* ``ColumnParallelDense`` — weight [in, out/K] per chip; output stays
+  sharded on features (no communication).
+* ``RowParallelDense`` — weight [in/K, out] per chip over feature-sharded
+  input; output is ``psum`` over the tp axis (the single collective).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+TP_AXIS = "tp"
+
+
+def _tp_size(axis_name: str) -> int:
+    return lax.axis_size(axis_name)
+
+
+class ColumnParallelDense(nn.Module):
+    """Dense with output features sharded over the tp axis.
+
+    Call inside shard_map with ``axis_name`` bound.  ``features`` is the
+    GLOBAL output width; each chip holds features/K columns.
+    """
+
+    features: int
+    axis_name: str = TP_AXIS
+    use_bias: bool = True
+    dtype = None
+
+    @nn.compact
+    def __call__(self, x):
+        k = _tp_size(self.axis_name)
+        if self.features % k:
+            raise ValueError(
+                f"features {self.features} not divisible by tp={k}")
+        local = self.features // k
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (x.shape[-1], local))
+        y = jnp.dot(x, kernel.astype(x.dtype))
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros, (local,))
+            y = y + bias.astype(y.dtype)
+        return y
+
+
+class RowParallelDense(nn.Module):
+    """Dense over input features sharded on the tp axis; psum-reduced output.
+
+    Input must already be feature-sharded (e.g. the output of a
+    ColumnParallelDense + elementwise nonlinearity).
+    """
+
+    features: int
+    axis_name: str = TP_AXIS
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (x.shape[-1], self.features))
+        y = jnp.dot(x, kernel.astype(x.dtype))
+        y = lax.psum(y, self.axis_name)          # the one TP collective
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros,
+                              (self.features,))
+            y = y + bias.astype(y.dtype)
+        return y
+
+
+class ParallelMLP(nn.Module):
+    """Column→act→Row two-layer MLP: exactly one psum per call."""
+
+    hidden: int
+    features: int
+    axis_name: str = TP_AXIS
+    act: Callable = nn.gelu
+
+    @nn.compact
+    def __call__(self, x):
+        h = ColumnParallelDense(self.hidden, self.axis_name,
+                                name="up")(x)
+        return RowParallelDense(self.features, self.axis_name,
+                                name="down")(self.act(h))

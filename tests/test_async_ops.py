@@ -44,3 +44,14 @@ def test_auto_names_unique(hvd):
     hs = [hvd.allreduce_async(np.ones(4, np.float32)) for _ in range(5)]
     for h in hs:
         hvd.synchronize(h)
+
+
+def test_barrier(hvd):
+    hvd.barrier()  # single process: completes once negotiated
+
+
+def test_keras_alias(hvd):
+    import horovod_tpu.keras as hvd_keras
+
+    assert hvd_keras.size() == 1
+    assert callable(hvd_keras.DistributedOptimizer)

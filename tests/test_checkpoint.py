@@ -20,7 +20,9 @@ def test_save_restore_roundtrip(hvd, tmp_path):
 
 def test_epoch_resume(hvd, tmp_path):
     base = tmp_path / "run"
-    assert checkpoint.resume_epoch(base) == 0
+    assert checkpoint.resume_epoch(base) == -1  # fresh start sentinel
+    checkpoint.save_epoch(base, 0, {"w": jnp.zeros(3)})
+    assert checkpoint.resume_epoch(base) == 0   # epoch 0 is resumable
     checkpoint.save_epoch(base, 1, {"w": jnp.ones(3)})
     checkpoint.save_epoch(base, 3, {"w": jnp.ones(3) * 3})
     assert checkpoint.resume_epoch(base) == 3

@@ -1,0 +1,62 @@
+"""Tensor-parallel layers: sharded MLP == unsharded math; composes with the
+data axis on a 2-D (hvd, tp) mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from horovod_tpu.parallel import ParallelMLP
+
+
+def test_parallel_mlp_matches_dense(hvd):
+    devs = np.array(jax.devices()[:4])
+    mesh = Mesh(devs, ("tp",))
+    model = ParallelMLP(hidden=32, features=8, axis_name="tp")
+    x = jax.random.normal(jax.random.PRNGKey(0), (6, 8))
+
+    def init_and_apply(x):
+        params = model.init(jax.random.PRNGKey(1), x)
+        return model.apply(params, x), params
+
+    # Per-chip params differ (each holds a shard); correctness check is that
+    # the function is linear-consistent: y(2x) for the row+psum pipeline of
+    # a linear (no-bias-effect) graph relates as expected.  Simplest strong
+    # check: run with tp=1 semantics by comparing against a manual gather.
+    out, params = jax.shard_map(
+        init_and_apply, mesh=mesh, in_specs=P(), out_specs=(P(), P("tp")),
+        check_vma=False)(x)
+
+    # Reconstruct full weights.  out_specs=P("tp") stacks each leaf's shards
+    # along dim 0: up kernel arrives as (4·in, local) row blocks; up bias as
+    # the concatenated (hidden,); down kernel as (4·in/4, out) = already the
+    # full row-parallel kernel; down bias as 4 identical copies.
+    pk = params["params"]
+    in_dim = x.shape[-1]
+    up_k = np.concatenate(
+        [np.asarray(pk["up"]["kernel"][i * in_dim:(i + 1) * in_dim])
+         for i in range(4)], axis=-1)
+    up_b = np.asarray(pk["up"]["bias"])
+    down_k = np.asarray(pk["down"]["kernel"])
+    down_b = np.asarray(pk["down"]["bias"][:8])[:model.features]
+    h = jax.nn.gelu(np.asarray(x) @ up_k + up_b)
+    ref = h @ down_k + down_b
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+
+def test_tp_with_data_axis(hvd):
+    devs = np.array(jax.devices()).reshape(2, 4)
+    mesh = Mesh(devs, ("hvd", "tp"))
+    model = ParallelMLP(hidden=16, features=4, axis_name="tp")
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, 4))
+
+    def fwd(x):
+        params = model.init(jax.random.PRNGKey(1), x)
+        y = model.apply(params, x)
+        # data-parallel mean over the hvd axis composes with tp
+        return jax.lax.pmean(y, "hvd")
+
+    out = jax.shard_map(fwd, mesh=mesh, in_specs=P("hvd"), out_specs=P("hvd"),
+                        check_vma=False)(x)
+    assert out.shape == (8, 4)
+    assert np.isfinite(np.asarray(out)).all()
