@@ -75,24 +75,36 @@ def main():
     # pytorch_mnist.py:93-96): each process keeps its slice; within the
     # process the mesh shards the per-host batch over local chips.
     x_all, y_all = synthetic_mnist()
-    x_all = x_all[hvd.rank()::hvd.size()]
-    y_all = y_all[hvd.rank()::hvd.size()]
-    steps = len(x_all) // global_batch
-    if steps == 0:
+    batches = hvd.data.ShardedBatches(x_all, y_all,
+                                      batch_per_chip=args.batch_size,
+                                      shuffle=True)
+    if len(batches) == 0:
         raise SystemExit(
-            f"per-process shard ({len(x_all)} rows) is smaller than the "
-            f"global batch ({global_batch}); lower --batch-size or add data.")
+            f"per-process shard is smaller than the global batch "
+            f"({global_batch}); lower --batch-size or add data.")
+
+    @jax.jit
+    @hvd.shard(in_specs=(P(), hvd.batch_spec(4), hvd.batch_spec(1)),
+               out_specs=P())
+    def eval_correct(params, x, y):
+        # Per-shard correct-count, psum-reduced: global accuracy in one
+        # compiled collective (reference evaluates test accuracy,
+        # keras_mnist.py:84-86 / MetricAverageCallback flow).
+        preds = jnp.argmax(model.apply(params, x), axis=-1)
+        return hvd.allreduce(jnp.sum(preds == y), average=False)
 
     for epoch in range(args.epochs):
         t0 = time.time()
         loss = None
-        for s in range(steps):
-            lo = s * global_batch
-            xb = jnp.asarray(x_all[lo:lo + global_batch])
-            yb = jnp.asarray(y_all[lo:lo + global_batch])
-            params, opt_state, loss = train_step(params, opt_state, xb, yb)
+        for xb, yb in batches:
+            params, opt_state, loss = train_step(
+                params, opt_state, jnp.asarray(xb), jnp.asarray(yb))
+        correct = sum(
+            int(eval_correct(params, jnp.asarray(xb), jnp.asarray(yb)))
+            for xb, yb in batches)
+        acc = correct / (len(batches) * global_batch)
         if hvd.rank() == 0:
-            print(f"epoch {epoch}: loss={float(loss):.4f} "
+            print(f"epoch {epoch}: loss={float(loss):.4f} acc={acc:.3f} "
                   f"({time.time() - t0:.1f}s)")
 
     # Horovod: checkpoint on rank 0 only (reference :108-110).

@@ -62,7 +62,10 @@ class CollectiveError(RuntimeError):
 
 
 def _build_library() -> None:
-    subprocess.run(["make", "-C", _HERE, "-j4"], check=True,
+    # Build the target matching the requested library (HVD_CORE_LIB may
+    # select the tsan build).
+    target = ["tsan"] if "tsan" in os.path.basename(_LIB_PATH) else []
+    subprocess.run(["make", "-C", _HERE, "-j4", *target], check=True,
                    capture_output=True)
 
 

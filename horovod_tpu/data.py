@@ -1,0 +1,75 @@
+"""Input-pipeline sharding helpers — the DistributedSampler pattern.
+
+The reference ships no loader of its own; its contract is "shard your data
+by rank" via ``DistributedSampler(num_replicas=hvd.size(), rank=hvd.rank())``
+(reference README.md:218-219, examples/pytorch_imagenet_resnet50.py:93-96).
+These helpers implement that contract for array/iterator pipelines feeding
+JAX, at both granularities:
+
+* process-level sharding (``shard_arrays`` / ``ShardedBatches``) — each host
+  loads only its slice (what DistributedSampler does);
+* within the host, ``hvd.shard``'s batch specs split the per-host batch over
+  local chips, so the global batch is ``batch_per_chip × num_chips()``.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator, Sequence
+
+import numpy as np
+
+from horovod_tpu import basics
+
+
+def shard_arrays(*arrays, drop_remainder: bool = True):
+    """Return each array's slice for this process (strided, like
+    DistributedSampler without shuffle).
+
+    With ``drop_remainder`` every process gets the same length (required for
+    SPMD lockstep — mismatched step counts hang collectives, the failure
+    mode the reference's stall checker exists to diagnose).
+    """
+    rank, size = basics.rank(), basics.size()
+    outs = []
+    n_min = min(len(a) for a in arrays) if arrays else 0
+    per = n_min // size if drop_remainder else None
+    for a in arrays:
+        s = a[rank::size]
+        outs.append(s[:per] if per is not None else s)
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+class ShardedBatches:
+    """Iterate epoch batches of a process-sharded dataset.
+
+    ``batch_per_chip`` follows the reference's per-accelerator batch-size
+    convention; the yielded batch is sized for all chips this process
+    drives (feed it straight to an ``hvd.shard``-wrapped step).
+    """
+
+    def __init__(self, *arrays: Sequence, batch_per_chip: int,
+                 shuffle: bool = True, seed: int = 0,
+                 drop_remainder: bool = True):
+        self.arrays = shard_arrays(*arrays, drop_remainder=drop_remainder)
+        if len(arrays) == 1:
+            self.arrays = (self.arrays,)
+        self.batch = batch_per_chip * basics.local_num_chips()
+        self.shuffle = shuffle
+        self.seed = seed
+        self._epoch = 0
+
+    def __len__(self) -> int:
+        return len(self.arrays[0]) // self.batch
+
+    def __iter__(self) -> Iterator[tuple]:
+        n = len(self.arrays[0])
+        idx = np.arange(n)
+        if self.shuffle:
+            # Same convention as DistributedSampler.set_epoch: reshuffle per
+            # epoch, deterministically, identically across restarts.
+            rng = np.random.RandomState(self.seed + self._epoch)
+            rng.shuffle(idx)
+        self._epoch += 1
+        for lo in range(0, n - self.batch + 1, self.batch):
+            sel = idx[lo:lo + self.batch]
+            yield tuple(np.asarray(a)[sel] for a in self.arrays)

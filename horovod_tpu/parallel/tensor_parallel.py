@@ -30,6 +30,17 @@ def _tp_size(axis_name: str) -> int:
     return lax.axis_size(axis_name)
 
 
+def _per_shard(base_init, axis_name: str):
+    """Fold the shard index into the param RNG so each tp rank initializes a
+    DISTINCT shard (inside shard_map every rank otherwise sees the same key
+    and the shards would be identical copies — collapsing the effective
+    width to features/K)."""
+    def init(rng, shape, *args):
+        rng = jax.random.fold_in(rng, lax.axis_index(axis_name))
+        return base_init(rng, shape, *args)
+    return init
+
+
 class ColumnParallelDense(nn.Module):
     """Dense with output features sharded over the tp axis.
 
@@ -40,7 +51,6 @@ class ColumnParallelDense(nn.Module):
     features: int
     axis_name: str = TP_AXIS
     use_bias: bool = True
-    dtype = None
 
     @nn.compact
     def __call__(self, x):
@@ -49,7 +59,10 @@ class ColumnParallelDense(nn.Module):
             raise ValueError(
                 f"features {self.features} not divisible by tp={k}")
         local = self.features // k
-        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+        # Column sharding keeps the full fan-in, so plain lecun is correct.
+        kernel = self.param("kernel",
+                            _per_shard(nn.initializers.lecun_normal(),
+                                       self.axis_name),
                             (x.shape[-1], local))
         y = jnp.dot(x, kernel.astype(x.dtype))
         if self.use_bias:
@@ -71,11 +84,18 @@ class RowParallelDense(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+        k = _tp_size(self.axis_name)
+        # The local kernel sees fan_in/K, so scale variance by 1/global
+        # fan-in explicitly (lecun over the local shape would be K× too hot).
+        init = nn.initializers.variance_scaling(1.0 / k, "fan_in",
+                                                "truncated_normal")
+        kernel = self.param("kernel", _per_shard(init, self.axis_name),
                             (x.shape[-1], self.features))
         y = jnp.dot(x, kernel.astype(x.dtype))
         y = lax.psum(y, self.axis_name)          # the one TP collective
         if self.use_bias:
+            # NOT per-shard: added after the psum, so it must be identical on
+            # every rank or the replicated output would diverge.
             bias = self.param("bias", nn.initializers.zeros,
                               (self.features,))
             y = y + bias.astype(y.dtype)

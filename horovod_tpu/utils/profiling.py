@@ -1,0 +1,41 @@
+"""Device-side profiling helpers — the XLA half of the timeline story.
+
+The native timeline (core/src/timeline.cc, HOROVOD_TIMELINE) covers the
+coordination plane; device compute/collective timing belongs to the XLA
+profiler (docs/timeline.md).  These wrappers make that one call:
+
+    with hvd.utils.profiling.trace("/tmp/jax-trace"):
+        for _ in range(10):
+            state = train_step(state, batch)
+
+View in XProf / TensorBoard (`tensorboard --logdir /tmp/jax-trace`) or
+Perfetto.  Rank-gated like every reference observability feature (only
+rank 0 traces by default).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from horovod_tpu import basics
+
+
+@contextlib.contextmanager
+def trace(path: str, *, all_ranks: bool = False):
+    """Capture an XLA profiler trace around the block (rank 0 only unless
+    ``all_ranks``)."""
+    import jax
+
+    enabled = all_ranks or not basics.is_initialized() or basics.rank() == 0
+    if not enabled:
+        yield
+        return
+    with jax.profiler.trace(path):
+        yield
+
+
+def annotate(name: str):
+    """Named span inside a trace (shows as a range in XProf)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)

@@ -43,6 +43,13 @@ def test_parallel_mlp_matches_dense(hvd):
     ref = h @ down_k + down_b
     np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
 
+    # Shards must be DISTINCT (per-shard RNG folding): identical copies
+    # would collapse the effective hidden width to hidden/K.
+    blocks = [np.asarray(pk["up"]["kernel"][i * in_dim:(i + 1) * in_dim])
+              for i in range(4)]
+    for i in range(1, 4):
+        assert not np.allclose(blocks[0], blocks[i])
+
 
 def test_tp_with_data_axis(hvd):
     devs = np.array(jax.devices()).reshape(2, 4)
