@@ -22,7 +22,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 import horovod_tpu as hvd
 from horovod_tpu.models import Transformer, TransformerConfig
-from horovod_tpu.parallel import make_ring_attention
+from horovod_tpu.parallel import make_ring_attention, make_ring_flash_attention
 
 
 def main():
@@ -33,6 +33,9 @@ def main():
     ap.add_argument("--embed", type=int, default=512)
     ap.add_argument("--steps", type=int, default=5)
     ap.add_argument("--batch", type=int, default=1)
+    ap.add_argument("--flash", action="store_true",
+                    help="fuse each ring step with the pallas flash kernel "
+                         "(O(S/n · D) per-step memory instead of O((S/n)²))")
     args = ap.parse_args()
 
     hvd.init()
@@ -44,8 +47,9 @@ def main():
                 num_heads=args.heads, head_dim=args.embed // args.heads,
                 embed_dim=args.embed, mlp_dim=4 * args.embed,
                 max_seq_len=args.seq_len)
-    model = Transformer(TransformerConfig(
-        **base, attention_fn=make_ring_attention("sp")))
+    attn = (make_ring_flash_attention("sp") if args.flash
+            else make_ring_attention("sp"))
+    model = Transformer(TransformerConfig(**base, attention_fn=attn))
     init_model = Transformer(TransformerConfig(**base))
     params = init_model.init(jax.random.PRNGKey(0),
                              jnp.zeros((1, s_local), jnp.int32))

@@ -38,7 +38,7 @@ except ImportError:  # pragma: no cover
 NEG_INF = -1e30
 
 
-def _flash_kernel(meta_ref, q_ref, k_ref, v_ref, o_ref, *,
+def _flash_kernel(meta_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
                   block_q: int, block_k: int, num_k_blocks: int,
                   causal: bool, scale: float):
     """One (batch·head, q-block) program: stream K/V blocks, online softmax.
@@ -78,8 +78,12 @@ def _flash_kernel(meta_ref, q_ref, k_ref, v_ref, o_ref, *,
     m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc0 = jnp.zeros((block_q, d), jnp.float32)
-    _, l, acc = jax.lax.fori_loop(0, num_k_blocks, body, (m0, l0, acc0))
+    m, l, acc = jax.lax.fori_loop(0, num_k_blocks, body, (m0, l0, acc0))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    # log-sum-exp per query row (NEG_INF where a row attended to nothing) —
+    # lets callers combine partial attentions exactly (ring attention).
+    lse = jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-30)), NEG_INF)
+    lse_ref[0] = lse[:, 0]
 
 
 def _pad_to(x, axis, multiple):
@@ -93,7 +97,7 @@ def _pad_to(x, axis, multiple):
 
 
 def _flash_forward(q, k, v, causal, q_offset, k_offset, block_q, block_k,
-                   interpret):
+                   interpret, *, with_lse: bool = False):
     b, s_q, h, d = q.shape
     s_k = k.shape[1]
     scale = d ** -0.5
@@ -115,7 +119,7 @@ def _flash_forward(q, k, v, causal, q_offset, k_offset, block_q, block_k,
         _flash_kernel, block_q=block_q, block_k=block_k,
         num_k_blocks=num_k_blocks, causal=causal, scale=scale)
     smem = {"memory_space": _SMEM} if _SMEM is not None else {}
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, num_q_blocks),
         in_specs=[
@@ -124,11 +128,21 @@ def _flash_forward(q, k, v, causal, q_offset, k_offset, block_q, block_k,
             pl.BlockSpec((1, kb.shape[1], d), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((1, kb.shape[1], d), lambda bh, qi: (bh, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct(qb.shape, q.dtype),
+        out_specs=(
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, qi: (bh, qi)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct(qb.shape, q.dtype),
+            jax.ShapeDtypeStruct(qb.shape[:2], jnp.float32),
+        ),
         interpret=interpret,
     )(meta, qb, kb, vb)
     out = out[:, :s_q].reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
+    if with_lse:
+        # [B·H, S] → [B, S, H]
+        lse = lse[:, :s_q].reshape(b, h, s_q).transpose(0, 2, 1)
+        return out, lse
     return out
 
 
@@ -185,6 +199,25 @@ def flash_attention(q, k, v, causal: bool = True, q_offset=0, k_offset=0,
     block_k = min(block_k, max(k.shape[1], 1))
     return _flash(q, k, v, causal, q_offset, k_offset, block_q, block_k,
                   interpret)
+
+
+def flash_attention_with_lse(q, k, v, causal: bool = True, q_offset=0,
+                             k_offset=0, block_q: int = 128,
+                             block_k: int = 128,
+                             interpret: bool | None = None):
+    """Forward-only fused attention returning (out, lse).
+
+    ``lse[b, s, h] = logsumexp_k(q·kᵀ·scale)`` (NEG_INF for rows that
+    attended to nothing) — the combiner state ring attention needs to merge
+    partial attentions over K/V blocks exactly.  Differentiation is handled
+    by the caller (ring attention recomputes per-block under its own vjp).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    block_q = min(block_q, max(q.shape[1], 1))
+    block_k = min(block_k, max(k.shape[1], 1))
+    return _flash_forward(q, k, v, causal, q_offset, k_offset, block_q,
+                          block_k, interpret, with_lse=True)
 
 
 def make_flash_attention(block_q: int = 128, block_k: int = 128):

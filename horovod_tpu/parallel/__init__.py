@@ -10,8 +10,10 @@ programs over the global mesh.
 
 from horovod_tpu.parallel.hierarchy import hierarchical_allreduce  # noqa: F401
 from horovod_tpu.parallel.ring_attention import (  # noqa: F401
-    ring_attention,
     make_ring_attention,
+    make_ring_flash_attention,
+    ring_attention,
+    ring_flash_attention,
 )
 from horovod_tpu.parallel.ulysses import (  # noqa: F401
     ulysses_attention,

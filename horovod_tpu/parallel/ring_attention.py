@@ -92,3 +92,76 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True):
 def make_ring_attention(axis_name: str):
     """Adapter producing a ``TransformerConfig.attention_fn``."""
     return functools.partial(ring_attention, axis_name=axis_name)
+
+
+# ---------------------------------------------------------------------------
+# Ring + flash: Pallas kernel inside each ring step
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def ring_flash_attention(q, k, v, axis_name: str, causal: bool = True,
+                         block_q: int = 128, block_k: int = 128):
+    """Ring attention whose per-step block attention is the fused Pallas
+    flash kernel (ops/flash_attention.py), merged across steps with exact
+    log-sum-exp combining.
+
+    Versus :func:`ring_attention` (einsum blocks): per-step peak memory
+    drops from O(S_local²) logits to O(S_local·D), so the maximum
+    per-chip sequence shard is set by K/V residency, not by the score
+    matrix.  Backward recomputes through the einsum ring (exact, O(S_local²)
+    transient in the cotangent pass only).
+    """
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+    from horovod_tpu.ops.flash_attention import flash_attention_with_lse
+
+    varying = functools.partial(lax.pcast, axis_name=axis_name, to="varying")
+    out = varying(jnp.zeros((b, s_local, h, d), jnp.float32))
+    lse = varying(jnp.full((b, s_local, h), NEG_INF, jnp.float32))
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, i):
+        k, v, out, lse = carry
+        owner = (my - i) % n
+        o_i, lse_i = flash_attention_with_lse(
+            q, k, v, causal=causal, q_offset=my * s_local,
+            k_offset=owner * s_local, block_q=block_q, block_k=block_k)
+        # Exact merge of two normalized partial attentions via their lse:
+        # combined = (out·e^{lse} + o_i·e^{lse_i}) / (e^{lse} + e^{lse_i}),
+        # computed at shifted max m.  Shapes: out [B,S,H,D]; weights [B,S,H,1].
+        m = jnp.maximum(lse, lse_i)
+        w_old = jnp.exp(lse - m)[..., None]
+        w_new = jnp.exp(lse_i - m)[..., None]
+        denom = jnp.maximum(w_old + w_new, 1e-30)
+        out = (out * w_old + o_i.astype(jnp.float32) * w_new) / denom
+        lse = m + jnp.log(denom[..., 0])
+        k = lax.ppermute(k, axis_name, perm)
+        v = lax.ppermute(v, axis_name, perm)
+        return (k, v, out, lse), None
+
+    (_, _, out, _), _ = lax.scan(step, (k, v, out, lse), jnp.arange(n))
+    return out.astype(q.dtype)
+
+
+def _ring_flash_fwd(q, k, v, axis_name, causal, block_q, block_k):
+    out = ring_flash_attention(q, k, v, axis_name, causal, block_q, block_k)
+    return out, (q, k, v)
+
+
+def _ring_flash_bwd(axis_name, causal, block_q, block_k, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: ring_attention(q, k, v, axis_name, causal=causal),
+        q, k, v)
+    return vjp(g)
+
+
+ring_flash_attention.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+def make_ring_flash_attention(axis_name: str, block_q: int = 128,
+                              block_k: int = 128):
+    """Adapter producing a ``TransformerConfig.attention_fn``."""
+    return functools.partial(ring_flash_attention, axis_name=axis_name,
+                             block_q=block_q, block_k=block_k)

@@ -211,3 +211,100 @@ def test_two_process_tcp(fn, expect):
     assert kinds == {expect}, results
     if expect == "collective-error":
         assert all("Mismatched shapes" in r[2] for r in results), results
+
+
+def _worker_peer_death(rank, size, port, q):
+    try:
+        eng = NativeEngine(rank, size, executor=local_executor,
+                           coordinator_host="127.0.0.1",
+                           coordinator_port=port, cycle_time_ms=2.0)
+        if rank == 1:
+            # Simulate a crashed peer: vanish without shutdown handshake.
+            # (Flush the queue feeder first or the message dies with us.)
+            import os
+            q.put(("died", rank, None))
+            q.close()
+            q.join_thread()
+            os._exit(1)
+        h = eng.enqueue("orphan", np.ones(4, np.float32), OP_ALLREDUCE)
+        try:
+            eng.synchronize(h, timeout_s=30)
+            q.put(("completed", rank, None))
+        except Exception as e:  # noqa: BLE001
+            q.put(("aborted", rank, type(e).__name__ + ": " + str(e)[:120]))
+        eng._shutdown.set()
+    except Exception as e:  # noqa: BLE001
+        q.put(("err", rank, repr(e)))
+
+
+def _worker_dtype_mismatch(rank, size, port, q):
+    try:
+        eng = NativeEngine(rank, size, executor=local_executor,
+                           coordinator_host="127.0.0.1",
+                           coordinator_port=port, cycle_time_ms=2.0)
+        x = np.ones(4, np.float32 if rank == 0 else np.float64)
+        h = eng.enqueue("badtype", x, OP_ALLREDUCE)
+        try:
+            eng.synchronize(h, timeout_s=30)
+            q.put(("no-error", rank, None))
+        except CollectiveError as e:
+            q.put(("collective-error", rank, str(e)))
+        eng.shutdown()
+    except Exception as e:  # noqa: BLE001
+        q.put(("err", rank, repr(e)))
+
+
+def _worker_root_mismatch(rank, size, port, q):
+    try:
+        eng = NativeEngine(rank, size, executor=local_executor,
+                           coordinator_host="127.0.0.1",
+                           coordinator_port=port, cycle_time_ms=2.0)
+        h = eng.enqueue("badroot", np.ones(2, np.float32), OP_BROADCAST,
+                        root_rank=rank)  #每 rank different root
+        try:
+            eng.synchronize(h, timeout_s=30)
+            q.put(("no-error", rank, None))
+        except CollectiveError as e:
+            q.put(("collective-error", rank, str(e)))
+        eng.shutdown()
+    except Exception as e:  # noqa: BLE001
+        q.put(("err", rank, repr(e)))
+
+
+def test_peer_death_aborts_instead_of_hanging():
+    """A crashed rank must fail the survivors' pending work, not hang them
+    (reference SHUT_DOWN_ERROR / transport-failure path)."""
+    ctx = multiprocessing.get_context("spawn")
+    port = _free_port()
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_worker_peer_death, args=(r, 2, port, q))
+             for r in range(2)]
+    for p in procs:
+        p.start()
+    results = [q.get(timeout=60) for _ in procs]
+    for p in procs:
+        p.join(timeout=30)
+        if p.is_alive():
+            p.terminate()
+    kinds = sorted(r[0] for r in results)
+    assert kinds == ["aborted", "died"], results
+
+
+@pytest.mark.parametrize("fn,needle", [
+    (_worker_dtype_mismatch, "Mismatched dtypes"),
+    (_worker_root_mismatch, "Mismatched root ranks"),
+])
+def test_mismatch_error_propagation(fn, needle):
+    ctx = multiprocessing.get_context("spawn")
+    port = _free_port()
+    q = ctx.Queue()
+    procs = [ctx.Process(target=fn, args=(r, 2, port, q)) for r in range(2)]
+    for p in procs:
+        p.start()
+    results = [q.get(timeout=60) for _ in procs]
+    for p in procs:
+        p.join(timeout=30)
+        if p.is_alive():
+            p.terminate()
+    assert {r[0] for r in results} == {"collective-error"}, results
+    assert all(needle in r[2] for r in results), results

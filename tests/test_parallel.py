@@ -128,3 +128,44 @@ def test_transformer_with_ring_attention(hvd):
     out = jax.shard_map(fwd, mesh=mesh, in_specs=(P(), P(None, "sp")),
                         out_specs=P(None, "sp"))(params, tokens)
     np.testing.assert_allclose(out, ref, atol=2e-4, rtol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_flash_attention_matches_dense(hvd, causal):
+    from horovod_tpu.parallel import ring_flash_attention
+
+    q, k, v = _qkv()
+    mesh = jax.sharding.Mesh(np.array(jax.devices()), ("sp",))
+    # check_vma=False: pallas_call outputs carry no vma info (hvd.shard's
+    # default); required whenever the flash kernel runs inside shard_map.
+    out = jax.shard_map(
+        lambda q, k, v: ring_flash_attention(q, k, v, "sp", causal,
+                                             block_q=4, block_k=4),
+        mesh=mesh, in_specs=P(None, "sp"), out_specs=P(None, "sp"),
+        check_vma=False)(q, k, v)
+    ref = dense_causal_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_ring_flash_attention_grads_match(hvd):
+    from horovod_tpu.parallel import ring_flash_attention
+
+    q, k, v = _qkv(s=16)
+    mesh = jax.sharding.Mesh(np.array(jax.devices()), ("sp",))
+
+    def loss_flash(q, k, v):
+        out = jax.shard_map(
+            lambda q, k, v: ring_flash_attention(q, k, v, "sp", True,
+                                                 block_q=2, block_k=2),
+            mesh=mesh, in_specs=P(None, "sp"),
+            out_specs=P(None, "sp"), check_vma=False)(q, k, v)
+        return (out.astype(jnp.float32) ** 2).sum()
+
+    def loss_dense(q, k, v):
+        return (dense_causal_attention(q, k, v, causal=True)
+                .astype(jnp.float32) ** 2).sum()
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(a, b, atol=5e-4, rtol=5e-4)
