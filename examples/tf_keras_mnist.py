@@ -1,0 +1,85 @@
+"""tf.keras MNIST with the full callback stack — analog of reference
+examples/keras_mnist_advanced.py (:1-127) and the callback/resume pattern of
+keras_imagenet_resnet50.py (:100-160): DistributedOptimizer, broadcast /
+metric-average / warmup / schedule callbacks, rank-0-only checkpointing,
+``hvd.load_model`` resume.
+
+Run: python examples/tf_keras_mnist.py [--resume]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import keras
+
+import horovod_tpu.tensorflow.keras as hvd
+from examples.tensorflow_mnist import synthetic_mnist
+
+CKPT = "/tmp/hvd_tpu_tf_keras_mnist.keras"
+
+
+def build_model():
+    return keras.Sequential([
+        keras.layers.Conv2D(32, 3, activation="relu"),
+        keras.layers.MaxPooling2D(2),
+        keras.layers.Flatten(),
+        keras.layers.Dense(128, activation="relu"),
+        keras.layers.Dense(10, activation="softmax"),
+    ])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=4)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.01)
+    ap.add_argument("--warmup-epochs", type=int, default=2)
+    ap.add_argument("--resume", action="store_true")
+    args = ap.parse_args()
+
+    hvd.init()
+
+    if args.resume and os.path.exists(CKPT):
+        # Horovod: re-wrap the saved optimizer in DistributedOptimizer
+        # (reference keras/__init__.py:115-148).
+        model = hvd.load_model(CKPT)
+    else:
+        model = build_model()
+        # Horovod: scale LR by worker count; wrap the optimizer.
+        opt = hvd.DistributedOptimizer(
+            keras.optimizers.SGD(args.lr * hvd.size(), momentum=0.9),
+            compression=hvd.Compression.bf16)
+        model.compile(optimizer=opt,
+                      loss="sparse_categorical_crossentropy",
+                      metrics=["accuracy"],
+                      jit_compile=False)  # collectives are host-engine ops
+
+    callbacks = [
+        # Horovod: start all workers from rank 0's state.
+        hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+        # Horovod: epoch metrics averaged over workers.
+        hvd.callbacks.MetricAverageCallback(),
+        # Horovod: LR warmup 1→size, then staircase decay.
+        hvd.callbacks.LearningRateWarmupCallback(
+            warmup_epochs=args.warmup_epochs, verbose=1),
+        hvd.callbacks.LearningRateScheduleCallback(
+            multiplier=0.1, start_epoch=args.warmup_epochs + 1),
+    ]
+    # Horovod: only rank 0 writes checkpoints (reference
+    # keras_imagenet_resnet50.py:157-160).
+    if hvd.rank() == 0:
+        callbacks.append(keras.callbacks.ModelCheckpoint(CKPT))
+
+    x, y = synthetic_mnist()
+    x = x[hvd.rank()::hvd.size()]
+    y = y[hvd.rank()::hvd.size()]
+
+    model.fit(x, y, batch_size=args.batch_size, epochs=args.epochs,
+              callbacks=callbacks, validation_split=0.1,
+              verbose=2 if hvd.rank() == 0 else 0)
+
+
+if __name__ == "__main__":
+    main()

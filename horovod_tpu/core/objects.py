@@ -1,0 +1,35 @@
+"""Engine-based broadcast of arbitrary Python objects.
+
+The reference's bindings each tensor-ize picklable state to move it through
+the collective layer (reference torch/__init__.py:197-228); here the
+numpy-level two-phase scheme (broadcast length, then payload bytes) lives
+once and the torch / TensorFlow bindings delegate to it.
+"""
+
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+
+
+def broadcast_object(obj, root_rank: int = 0, name: str = "bcast_obj"):
+    """Broadcast a picklable object from ``root_rank`` via the engine."""
+    from horovod_tpu import basics
+    from horovod_tpu.core import engine as engine_mod
+
+    if basics.size() == 1:
+        return obj
+    eng = engine_mod.get_engine()
+    if basics.rank() == root_rank:
+        payload = np.frombuffer(pickle.dumps(obj), np.uint8).copy()
+    else:
+        payload = np.zeros(0, np.uint8)
+    h = eng.enqueue(name + ".len", np.array([payload.size], np.int64),
+                    engine_mod.OP_BROADCAST, root_rank=root_rank)
+    n = int(eng.synchronize(h)[0])
+    if payload.size != n:
+        payload = np.zeros(n, np.uint8)
+    h = eng.enqueue(name + ".data", payload, engine_mod.OP_BROADCAST,
+                    root_rank=root_rank)
+    return pickle.loads(eng.synchronize(h).tobytes())

@@ -1,36 +1,296 @@
-"""TensorFlow binding — gated (TensorFlow is not in this environment).
+"""TensorFlow binding — the reference's largest framework surface.
 
-The reference's largest binding is TensorFlow (reference
-horovod/tensorflow/*); this image ships no TensorFlow, so rather than a
-silent ImportError users get the reference's actionable ``check_extension``
-behaviour (reference common/__init__.py:43-48): a clear message naming the
-equivalent APIs.  Every public symbol of the reference TF surface is listed
-so ``from horovod_tpu.tensorflow import DistributedOptimizer`` fails with
-guidance instead of AttributeError.
+Rebuild of reference horovod/tensorflow/__init__.py: ``allreduce`` with the
+``tf.IndexedSlices`` sparse path (two allgathers, reference :67-78),
+``broadcast_global_variables`` / ``BroadcastGlobalVariablesHook``
+(reference :90-133), and ``DistributedOptimizer`` (reference :135-225) —
+plus the TF-2 idioms the 2018 reference predates: ``broadcast_variables``
+for eager variable lists and ``DistributedGradientTape`` for custom
+training loops.  Tensors route through the native coordination engine via
+the numpy bridge exactly like the torch binding (mpi_ops.py here).
 """
 
 from __future__ import annotations
 
-_MESSAGE = (
-    "horovod_tpu was built for the JAX/TPU stack; TensorFlow is not "
-    "available in this environment. Equivalent APIs: "
-    "horovod_tpu.DistributedOptimizer (optax), "
-    "horovod_tpu.flax (Keras-style facade: TrainState/load_model/callbacks), "
-    "horovod_tpu.torch (eager binding), "
-    "hvd.broadcast_parameters (BroadcastGlobalVariablesHook), "
-    "hvd.allreduce/allgather/broadcast (tf ops)."
+import itertools
+
+import numpy as np
+import tensorflow as tf
+
+from horovod_tpu.core.objects import broadcast_object as _broadcast_object
+
+_bcast_counter = itertools.count()
+
+from horovod_tpu.tensorflow.compression import Compression  # noqa: E402
+from horovod_tpu.tensorflow.mpi_ops import (  # noqa: F401
+    _allreduce, allgather, broadcast, init, shutdown, size, local_size,
+    rank, local_rank, mpi_threads_supported,
 )
 
-_TF_SURFACE = [
-    # reference tensorflow/__init__.py + mpi_ops.py exports
-    "DistributedOptimizer", "BroadcastGlobalVariablesHook",
-    "broadcast_global_variables", "allreduce", "allgather", "broadcast",
-    "init", "shutdown", "size", "local_size", "rank", "local_rank",
-    "mpi_threads_supported", "Compression",
-]
+
+def allreduce(tensor, average=True, device_dense='', device_sparse='',
+              compression=Compression.none, name=None):
+    """Allreduce a tf.Tensor / tf.Variable / tf.IndexedSlices.
+
+    Dense path: compress → sum-allreduce → decompress → divide by size if
+    ``average`` (reference tensorflow/__init__.py:79-87).  Sparse path
+    (``tf.IndexedSlices``, e.g. embedding gradients): allgather values and
+    indices instead — an allreduce of the represented dense tensor without
+    densifying (reference :67-78).  ``device_*`` args are accepted for API
+    parity; device placement is XLA/engine-controlled here.
+    """
+    if isinstance(tensor, tf.IndexedSlices):
+        values = allgather(tensor.values,
+                           name=name and name + ".values")
+        indices = allgather(tensor.indices,
+                            name=name and name + ".indices")
+        if average:
+            horovod_size = tf.cast(size(), tensor.values.dtype)
+            values = tf.math.divide(values, horovod_size)
+        return tf.IndexedSlices(values, indices,
+                                dense_shape=tensor.dense_shape)
+    tensor = tf.convert_to_tensor(tensor)
+    tensor_compressed, ctx = compression.compress(tensor)
+    summed = _allreduce(tensor_compressed, name=name)
+    summed = compression.decompress(summed, ctx)
+    if not average:
+        return summed
+    if tensor.dtype.is_floating or tensor.dtype.is_complex:
+        return tf.math.divide(summed, tf.cast(size(), summed.dtype))
+    # Integer average truncates toward zero (documented; matches the torch
+    # binding's rounding_mode="trunc" — floor division would diverge on
+    # negative sums).
+    return tf.truncatediv(summed, tf.cast(size(), summed.dtype))
 
 
-def __getattr__(name):
-    if name in _TF_SURFACE:
-        raise NotImplementedError(_MESSAGE)
-    raise AttributeError(name)
+def broadcast_variables(variables, root_rank):
+    """Assign every variable its ``root_rank`` value (TF-2 eager analog of
+    reference broadcast_global_variables, which walked the TF-1 global
+    variables collection).
+
+    All broadcasts are enqueued before any is awaited, so the engine can
+    batch/fuse them — the same enqueue-all-then-synchronize shape as the
+    torch binding's ``broadcast_parameters`` (torch/state.py).
+    """
+    from horovod_tpu.core import engine as engine_mod
+
+    variables = list(variables)
+    if not variables:
+        return
+    eng = engine_mod.get_engine()
+    batch = next(_bcast_counter)
+    handles = []
+    for i, var in enumerate(variables):
+        # Decide scalar-ness from the static shape — .numpy() does not
+        # reliably preserve 0-d shapes in this environment.
+        scalar = var.shape.rank == 0
+        arr = np.ascontiguousarray(var.numpy()).reshape(
+            (1,) if scalar else tuple(var.shape.as_list()))
+        h = eng.enqueue(f"tf.broadcast_vars.{batch}.{i}", arr,
+                        engine_mod.OP_BROADCAST, root_rank=root_rank)
+        handles.append((var, scalar, h))
+    for var, scalar, h in handles:
+        out = eng.synchronize(h)
+        var.assign(out.reshape(()) if scalar else out)
+
+
+def broadcast_global_variables(root_rank):
+    """Broadcast all TF-1 global variables (reference :90-98).
+
+    Only meaningful in graph mode — TF 2 removed the global-variables
+    collection; eager users should call ``broadcast_variables`` with an
+    explicit list (e.g. ``model.variables``).
+    """
+    if tf.executing_eagerly():
+        raise RuntimeError(
+            "broadcast_global_variables requires TF-1 graph mode; in eager "
+            "TF-2 use hvd.broadcast_variables(model.variables, root_rank).")
+    gvars = tf.compat.v1.global_variables()
+    return tf.group(*[tf.compat.v1.assign(var, broadcast(var, root_rank))
+                      for var in gvars])
+
+
+class BroadcastGlobalVariablesHook(tf.compat.v1.train.SessionRunHook):
+    """SessionRunHook broadcasting all global variables from ``root_rank``
+    after session creation (reference tensorflow/__init__.py:101-133) — for
+    ``tf.compat.v1`` MonitoredTrainingSession-style loops."""
+
+    def __init__(self, root_rank, device=''):
+        super().__init__()
+        self.root_rank = root_rank
+        self.bcast_op = None
+        self.device = device
+
+    def begin(self):
+        if (not self.bcast_op
+                or self.bcast_op.graph != tf.compat.v1.get_default_graph()):
+            with tf.device(self.device):
+                self.bcast_op = broadcast_global_variables(self.root_rank)
+
+    def after_create_session(self, session, coord):
+        session.run(self.bcast_op)
+
+
+def _allreduce_grad_value(grad, compression, sparse_as_dense,
+                          device_dense='', device_sparse=''):
+    """The per-gradient routing shared by every optimizer/tape wrapper:
+    None passes through; IndexedSlices densify under ``sparse_as_dense``
+    (reference :197-199) or take the allgather sparse path; dense tensors
+    take compress→allreduce→decompress."""
+    if grad is None:
+        return None
+    if sparse_as_dense and isinstance(grad, tf.IndexedSlices):
+        grad = tf.convert_to_tensor(grad)
+    return allreduce(grad, device_dense=device_dense,
+                     device_sparse=device_sparse, compression=compression)
+
+
+class _DistributedOptimizerV1(tf.compat.v1.train.Optimizer):
+    """TF-1 optimizer wrapper: override ``compute_gradients`` to allreduce
+    (reference tensorflow/__init__.py:135-225)."""
+
+    def __init__(self, optimizer, name=None, use_locking=False,
+                 device_dense='', device_sparse='',
+                 compression=Compression.none, sparse_as_dense=False):
+        if name is None:
+            name = "Distributed{}".format(type(optimizer).__name__)
+        self._optimizer = optimizer
+        self._device_dense = device_dense
+        self._device_sparse = device_sparse
+        self._compression = compression
+        self._sparse_as_dense = sparse_as_dense
+        super().__init__(name=name, use_locking=use_locking)
+
+    def compute_gradients(self, *args, **kwargs):
+        gradients = self._optimizer.compute_gradients(*args, **kwargs)
+        if size() <= 1:
+            return gradients
+        with tf.name_scope(self._name + "_Allreduce"):
+            return [(_allreduce_grad_value(
+                grad, self._compression, self._sparse_as_dense,
+                self._device_dense, self._device_sparse), var)
+                for grad, var in gradients]
+
+    def apply_gradients(self, *args, **kwargs):
+        return self._optimizer.apply_gradients(*args, **kwargs)
+
+    def get_slot(self, *args, **kwargs):
+        return self._optimizer.get_slot(*args, **kwargs)
+
+    def get_slot_names(self, *args, **kwargs):
+        return self._optimizer.get_slot_names(*args, **kwargs)
+
+    def variables(self, *args, **kwargs):
+        return self._optimizer.variables(*args, **kwargs)
+
+
+def _create_distributed_keras_class(cls, name=None,
+                                    compression=Compression.none,
+                                    sparse_as_dense=False):
+    """Dynamic subclass of a keras-3 optimizer class whose ``apply``
+    allreduces gradients first — the keras-3 hook point every entry
+    (``fit`` → ``apply_gradients`` → ``apply``, and direct
+    ``apply_gradients`` calls) funnels through.  Mirrors reference
+    keras/impl.py:20-61, which subclassed and overrode ``get_gradients``
+    (the keras-2 hook point).  Returned as a class (not an instance) so it
+    can also serve as a keras deserialization target in ``load_model``."""
+
+    class _DistributedKerasOptimizer(cls):
+        _hvd_compression = compression
+        _hvd_sparse_as_dense = sparse_as_dense
+
+        def apply(self, grads, trainable_variables=None):
+            if size() > 1:
+                grads = [
+                    _allreduce_grad_value(g, self._hvd_compression,
+                                          self._hvd_sparse_as_dense)
+                    for g in grads]
+            return super().apply(grads, trainable_variables)
+
+    _DistributedKerasOptimizer.__name__ = (
+        name or "Distributed{}".format(cls.__name__))
+    return _DistributedKerasOptimizer
+
+
+def _create_distributed_keras_optimizer(optimizer, name=None,
+                                        compression=Compression.none,
+                                        sparse_as_dense=False):
+    dcls = _create_distributed_keras_class(
+        type(optimizer), name=name, compression=compression,
+        sparse_as_dense=sparse_as_dense)
+    return dcls.from_config(optimizer.get_config())
+
+
+def DistributedOptimizer(optimizer, name=None, use_locking=False,
+                         device_dense='', device_sparse='',
+                         compression=Compression.none,
+                         sparse_as_dense=False):
+    """Wrap an optimizer so gradients are averaged across processes before
+    being applied (reference tensorflow/__init__.py:135-225).
+
+    Accepts a ``tf.compat.v1.train.Optimizer`` (graph-mode wrapper, exactly
+    the reference's design) or a keras-3 optimizer (eager/``model.fit``
+    path; gradients — including ``tf.IndexedSlices`` from embedding layers
+    — are allreduced inside ``apply``).
+    """
+    if isinstance(optimizer, tf.compat.v1.train.Optimizer):
+        return _DistributedOptimizerV1(
+            optimizer, name=name, use_locking=use_locking,
+            device_dense=device_dense, device_sparse=device_sparse,
+            compression=compression, sparse_as_dense=sparse_as_dense)
+    import keras
+
+    if isinstance(optimizer, keras.optimizers.Optimizer):
+        return _create_distributed_keras_optimizer(
+            optimizer, name=name, compression=compression,
+            sparse_as_dense=sparse_as_dense)
+    raise TypeError(
+        "DistributedOptimizer expects a tf.compat.v1.train.Optimizer or a "
+        f"keras optimizer, got {type(optimizer)!r}")
+
+
+class _DistributedGradientTape:
+    def __init__(self, tape, device_dense='', device_sparse='',
+                 compression=Compression.none, sparse_as_dense=False):
+        self._tape = tape
+        self._device_dense = device_dense
+        self._device_sparse = device_sparse
+        self._compression = compression
+        self._sparse_as_dense = sparse_as_dense
+
+    def __getattr__(self, item):
+        return getattr(self._tape, item)
+
+    def __enter__(self):
+        self._tape.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._tape.__exit__(*exc)
+
+    def gradient(self, target, sources, output_gradients=None):
+        grads = self._tape.gradient(target, sources, output_gradients)
+        if size() <= 1:
+            return grads
+        return tf.nest.map_structure(
+            lambda g: _allreduce_grad_value(
+                g, self._compression, self._sparse_as_dense,
+                self._device_dense, self._device_sparse),
+            grads)
+
+
+def DistributedGradientTape(gradtape, device_dense='', device_sparse='',
+                            compression=Compression.none,
+                            sparse_as_dense=False):
+    """Wrap a ``tf.GradientTape`` so ``gradient()`` returns allreduced
+    gradients — the TF-2 custom-training-loop analog of
+    ``DistributedOptimizer.compute_gradients``."""
+    return _DistributedGradientTape(gradtape, device_dense, device_sparse,
+                                    compression, sparse_as_dense)
+
+
+def broadcast_object(obj, root_rank=0, name=None):
+    """Broadcast an arbitrary picklable object (shared engine-level
+    two-phase scheme, horovod_tpu/core/objects.py)."""
+    return _broadcast_object(obj, root_rank,
+                             name=name or "tf.broadcast_object")

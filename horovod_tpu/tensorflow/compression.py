@@ -1,0 +1,66 @@
+"""Gradient compression for TensorFlow tensors.
+
+Reference horovod/tensorflow/compression.py:24-74 in behaviour:
+``Compression.none`` / ``Compression.fp16`` cast floating tensors to half
+for the wire and back after; plus ``Compression.bf16`` (TPU-native wire
+format, not in the reference).
+"""
+
+from __future__ import annotations
+
+import tensorflow as tf
+
+
+class Compressor:
+    """Interface: ``compress(tensor) -> (tensor, ctx)``; ``decompress(tensor, ctx)``."""
+
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class _CastCompressor(Compressor):
+    wire_dtype: tf.DType
+
+    @classmethod
+    def compress(cls, tensor):
+        ctx = tensor.dtype
+        if ctx.is_floating and ctx != cls.wire_dtype:
+            return tf.cast(tensor, cls.wire_dtype), ctx
+        return tensor, ctx
+
+    @classmethod
+    def decompress(cls, tensor, ctx):
+        if ctx is not None and tensor.dtype != ctx:
+            return tf.cast(tensor, ctx)
+        return tensor
+
+
+class FP16Compressor(_CastCompressor):
+    wire_dtype = tf.float16
+
+
+class BF16Compressor(_CastCompressor):
+    wire_dtype = tf.bfloat16
+
+
+class Compression:
+    """Registry, mirroring reference compression.py:66-74."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

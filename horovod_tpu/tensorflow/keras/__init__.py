@@ -1,0 +1,70 @@
+"""tf.keras surface (reference horovod/tensorflow/keras/__init__.py).
+
+``DistributedOptimizer`` wraps a keras optimizer so gradients are averaged
+across processes; ``load_model`` deserializes a saved model while re-wrapping
+its optimizer (reference keras/__init__.py:115-148, keras/impl.py:64-109);
+callbacks live in :mod:`horovod_tpu.tensorflow.keras.callbacks`.
+
+Keras 3 note: compile models with ``jit_compile=False`` — collectives leave
+the graph through the host engine (see tensorflow/mpi_ops.py docstring).
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import keras
+
+from horovod_tpu.tensorflow import (  # noqa: F401
+    allgather, allreduce, broadcast, broadcast_object, broadcast_variables,
+    init, shutdown, size, local_size, rank, local_rank,
+    mpi_threads_supported,
+    _create_distributed_keras_class, _create_distributed_keras_optimizer,
+)
+from horovod_tpu.tensorflow.compression import Compression  # noqa: F401
+from horovod_tpu.tensorflow.keras import callbacks  # noqa: F401
+
+
+def DistributedOptimizer(optimizer, name=None,
+                         compression=Compression.none,
+                         sparse_as_dense=False):
+    """An optimizer that averages gradients across all processes before
+    applying them (reference tensorflow/keras/__init__.py:103-125)."""
+    return _create_distributed_keras_optimizer(
+        optimizer, name=name, compression=compression,
+        sparse_as_dense=sparse_as_dense)
+
+
+def _optimizer_classes():
+    out = []
+    for obj_name in dir(keras.optimizers):
+        obj = getattr(keras.optimizers, obj_name)
+        if (inspect.isclass(obj)
+                and issubclass(obj, keras.optimizers.Optimizer)):
+            out.append(obj)
+    return out
+
+
+def load_model(filepath, custom_optimizers=None, custom_objects=None,
+               compression=Compression.none):
+    """Load a saved model, re-wrapping its optimizer in
+    ``DistributedOptimizer`` so resumed training keeps averaging gradients
+    (reference keras/__init__.py:115-148).
+
+    ``custom_optimizers``: extra optimizer classes to recognize.
+    ``custom_objects``: passed through to keras deserialization (wins on
+    name conflicts).
+    """
+
+    horovod_objects = {}
+    for cls in _optimizer_classes() + list(custom_optimizers or []):
+        # Keras-3 deserialization requires classes (it calls from_config),
+        # not factory functions as in the keras-2 reference.
+        dcls = _create_distributed_keras_class(cls, compression=compression)
+        horovod_objects[cls.__name__] = dcls
+        # Models saved while compiled with DistributedOptimizer serialize
+        # the dynamic subclass name.
+        horovod_objects["Distributed{}".format(cls.__name__)] = dcls
+    if custom_objects:
+        horovod_objects.update(custom_objects)
+    return keras.models.load_model(filepath, custom_objects=horovod_objects)

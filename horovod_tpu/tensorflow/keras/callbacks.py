@@ -1,0 +1,185 @@
+"""tf.keras callbacks (reference horovod/tensorflow/keras/callbacks.py and
+the shared impls in horovod/keras/callbacks_impl.py).
+
+* ``BroadcastGlobalVariablesCallback`` — broadcast model + optimizer state
+  from the root rank at train begin (reference callbacks_impl.py:20-30).
+* ``MetricAverageCallback`` — allreduce-average epoch metrics in place
+  (reference callbacks_impl.py:33-67).
+* ``LearningRateScheduleCallback`` — multiplier schedules with momentum
+  correction (reference callbacks_impl.py:70-146).
+* ``LearningRateWarmupCallback`` — gradual 1→size LR ramp
+  (reference callbacks_impl.py:149-168).
+
+Momentum correction here scales the optimizer's velocity slots directly by
+``new_lr / old_lr`` at the moment of the LR change, which is algebraically
+identical to the reference's trick of scaling the momentum hyperparameter
+for one batch and restoring it (keras velocities carry the LR factor:
+v' = m·(v·new/old) − new_lr·g  ≡  m·(new/old)·v − new_lr·g) — and unlike a
+Python attribute write, a variable assign takes effect inside the traced
+``tf.function`` train step.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import keras
+
+import horovod_tpu.tensorflow as hvd
+
+
+class BroadcastGlobalVariablesCallback(keras.callbacks.Callback):
+    """Broadcast initial model and optimizer state from ``root_rank`` so all
+    workers start identically (reference callbacks_impl.py:20-30)."""
+
+    def __init__(self, root_rank=0, device=''):
+        super().__init__()
+        self.root_rank = root_rank
+        self._done = False
+
+    def on_train_begin(self, logs=None):
+        if self._done:
+            return
+        variables = list(self.model.variables)
+        opt = getattr(self.model, "optimizer", None)
+        if opt is not None:
+            variables += list(opt.variables)
+        hvd.broadcast_variables(variables, self.root_rank)
+        self._done = True
+
+
+class MetricAverageCallback(keras.callbacks.Callback):
+    """Average epoch-end metrics over all processes in place, so checkpoint
+    / early-stopping / logging callbacks downstream see global values
+    (reference callbacks_impl.py:33-67)."""
+
+    def __init__(self, device=''):
+        super().__init__()
+
+    def _average_metrics_in_place(self, logs):
+        logs = logs or {}
+        for metric, value in sorted(logs.items()):
+            if np.isscalar(value) or getattr(value, "ndim", None) == 0:
+                reduced = hvd.allreduce(
+                    np.asarray(value, dtype=np.float64), average=True,
+                    name=f"metric.{metric}")
+                logs[metric] = float(reduced.numpy())
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._average_metrics_in_place(logs)
+
+
+def _momentum_slots(optimizer):
+    """The velocity variables of a momentum optimizer (keras-3 SGD keeps
+    them in ``optimizer.momentums``), or [] when momentum does not apply."""
+    if getattr(optimizer, "momentum", 0.0):
+        slots = getattr(optimizer, "momentums", None)
+        if slots:
+            return list(slots)
+    return []
+
+
+class LearningRateScheduleCallback(keras.callbacks.Callback):
+    """Multiply the initial LR by ``multiplier(epoch)`` within
+    [start_epoch, end_epoch) (reference callbacks_impl.py:70-146).
+
+    ``staircase=True`` adjusts once per epoch on its first batch;
+    ``staircase=False`` adjusts every batch at fractional epochs (requires
+    ``steps_per_epoch`` or autodetection from ``params``).
+    """
+
+    def __init__(self, multiplier, start_epoch=0, end_epoch=None,
+                 staircase=True, momentum_correction=True,
+                 steps_per_epoch=None):
+        super().__init__()
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.momentum_correction = momentum_correction
+        self.initial_lr = None
+        self.steps_per_epoch = steps_per_epoch
+        self.current_epoch = None
+        if not callable(multiplier):
+            self.staircase = True
+            self.multiplier = lambda epoch: multiplier
+        else:
+            self.multiplier = multiplier
+
+    # -- helpers ----------------------------------------------------------
+
+    def _get_lr(self) -> float:
+        return float(
+            keras.ops.convert_to_numpy(self.model.optimizer.learning_rate))
+
+    def _set_lr(self, value: float) -> None:
+        self.model.optimizer.learning_rate = value
+
+    def _autodetect_steps_per_epoch(self):
+        if self.params.get("steps"):
+            return self.params["steps"]
+        if self.params.get("samples") and self.params.get("batch_size"):
+            return self.params["samples"] // self.params["batch_size"]
+        raise ValueError(
+            "Could not autodetect steps_per_epoch; pass steps_per_epoch to "
+            f"{type(self).__name__}().")
+
+    def _adjust_learning_rate(self, epoch):
+        old_lr = self._get_lr()
+        new_lr = self.initial_lr * self.multiplier(epoch)
+        self._set_lr(new_lr)
+        if self.momentum_correction and old_lr > 0 and new_lr != old_lr:
+            # See module docstring: scaling the velocity slots by
+            # new/old ≡ the reference's one-batch momentum-hyper scaling.
+            scale = new_lr / old_lr
+            for slot in _momentum_slots(self.model.optimizer):
+                slot.assign(slot * scale)
+
+    # -- keras hooks ------------------------------------------------------
+
+    def on_train_begin(self, logs=None):
+        self.initial_lr = self._get_lr()
+        if not self.staircase and not self.steps_per_epoch:
+            self.steps_per_epoch = self._autodetect_steps_per_epoch()
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.current_epoch = epoch
+
+    def on_train_batch_begin(self, batch, logs=None):
+        if (self.current_epoch < self.start_epoch
+                or (self.end_epoch is not None
+                    and self.current_epoch >= self.end_epoch)):
+            return
+        if self.staircase and batch == 0:
+            self._adjust_learning_rate(self.current_epoch)
+        elif not self.staircase:
+            epoch = self.current_epoch + float(batch) / self.steps_per_epoch
+            self._adjust_learning_rate(epoch)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs is not None:
+            logs["lr"] = self._get_lr()
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Ramp the LR from its base value to ``base * size`` over
+    ``warmup_epochs`` (reference callbacks_impl.py:149-168) — the "gradual
+    warmup" of Goyal et al., matched to LR-scaled large-batch training."""
+
+    def __init__(self, warmup_epochs=5, momentum_correction=True,
+                 steps_per_epoch=None, verbose=0):
+        def multiplier(epoch):
+            # Round numbers at epoch ends for nicer LR curves.
+            epoch += 1.0 / self.steps_per_epoch
+            return 1.0 / hvd.size() * (
+                epoch * (hvd.size() - 1) / warmup_epochs + 1)
+
+        super().__init__(multiplier, start_epoch=0, end_epoch=warmup_epochs,
+                         staircase=False,
+                         momentum_correction=momentum_correction,
+                         steps_per_epoch=steps_per_epoch)
+        self.verbose = verbose
+
+    def on_epoch_end(self, epoch, logs=None):
+        super().on_epoch_end(epoch, logs)
+        if epoch == self.end_epoch - 1 and self.verbose > 0:
+            print("\nEpoch %d: finished gradual learning rate warmup to %g."
+                  % (epoch + 1, self._get_lr()))

@@ -1,0 +1,154 @@
+"""TensorFlow tensor collectives over the native engine.
+
+Rebuild of reference horovod/tensorflow/mpi_ops.py (+ the C++ custom-op
+kernels tensorflow/mpi_ops.cc it loads): ``_allreduce`` / ``allgather`` /
+``broadcast`` with registered gradients for all three (reference
+mpi_ops.py:93-182).  Instead of TF custom ops compiled against the TF ABI,
+eager tensors cross into the engine as numpy arrays (zero-copy for native
+dtypes; bfloat16 arrives as an ml_dtypes view) wrapped in ``tf.py_function``
+so the same ops also work inside a non-XLA ``tf.function`` graph.  Gradients
+use ``tf.custom_gradient`` instead of ``tf.RegisterGradient`` (the TF-2
+idiom for the same registration).
+
+The SPMD/jit compute path of this framework is JAX; this binding is the
+eager/host control-plane analog of the reference's TF support, so
+``py_function`` (host roundtrip) is the faithful architecture, not a
+limitation: the reference's custom ops also leave the TF graph to enqueue
+into the background engine (reference tensorflow/mpi_ops.cc:281-303).
+Under ``jit_compile=True`` (XLA) ``py_function`` is unsupported — compile
+keras models with ``jit_compile=False``.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+import tensorflow as tf
+
+from horovod_tpu import basics
+from horovod_tpu.core import engine as engine_mod
+
+# Basic lifecycle API, re-exported like reference mpi_ops.py:63-69.
+init = basics.init
+shutdown = basics.shutdown
+size = basics.size
+local_size = basics.local_size
+rank = basics.rank
+local_rank = basics.local_rank
+mpi_threads_supported = basics.mpi_threads_supported
+
+_counter = itertools.count()
+
+_OP_PREFIX = {
+    engine_mod.OP_ALLREDUCE: "HorovodAllreduce",
+    engine_mod.OP_ALLGATHER: "HorovodAllgather",
+    engine_mod.OP_BROADCAST: "HorovodBroadcast",
+}
+
+
+def _collective(tensor, op: int, name: str | None, root_rank: int = -1):
+    """Run one engine collective on a tf tensor (sync), graph-compatible."""
+    tensor = tf.convert_to_tensor(tensor)
+    # The engine works on buffers with a leading axis; round-trip scalars
+    # through shape (1,).  (Done at the tf level — py_function does not
+    # reliably preserve 0-d shapes.)
+    scalar = tensor.shape.rank == 0
+    if scalar:
+        tensor = tf.reshape(tensor, [1])
+    # Bind the auto-name NOW (call/trace time, where program order is
+    # deterministic and identical across ranks) — taking the counter inside
+    # the executed closure would let TF's runtime execution order assign
+    # names differently per rank, mispairing tensors in the engine.  Same
+    # rationale as the reference's per-graph-node names (mpi_ops.py:88-89)
+    # and the torch binding's call-time counter (torch/mpi_ops.py:31).
+    n = (name if name is not None
+         else f"tf.{_OP_PREFIX[op]}.noname.{next(_counter)}")
+
+    def _run(t):
+        eng = engine_mod.get_engine()
+        arr = np.ascontiguousarray(t.numpy())
+        h = eng.enqueue(n, arr, op, root_rank=root_rank)
+        return eng.synchronize(h)
+
+    out = tf.py_function(_run, [tensor], Tout=tensor.dtype)
+    if op == engine_mod.OP_ALLGATHER:
+        # dim 0 is the sum of per-rank dim-0 sizes — unknown statically.
+        # (A gathered scalar keeps its (size,) shape — the gather axis is
+        # meaningful output.)
+        out.set_shape(tf.TensorShape([None]).concatenate(tensor.shape[1:]))
+    else:
+        out.set_shape(tensor.shape)
+        if scalar:
+            out = tf.reshape(out, [])
+    return out
+
+
+def _allreduce(tensor, name=None):
+    """Sum ``tensor`` over all processes (reference mpi_ops.py:77-90).
+
+    Differentiable: grad(allreduce) = allreduce (reference mpi_ops.py:93-104).
+    """
+
+    @tf.custom_gradient
+    def _fn(x):
+        y = _collective(x, engine_mod.OP_ALLREDUCE, name)
+
+        def grad(dy):
+            return _allreduce(dy)
+
+        return y, grad
+
+    return _fn(tf.convert_to_tensor(tensor))
+
+
+def allgather(tensor, name=None):
+    """Concatenate ``tensor`` along dim 0 across processes; per-rank dim-0
+    sizes may differ (reference mpi_ops.py:107-123).
+
+    Differentiable: grad = allreduce of the upstream grad, then the local
+    rank's dim-0 slice (reference mpi_ops.py:126-147).
+    """
+    tensor = tf.convert_to_tensor(tensor)
+    if tensor.shape.rank == 0:
+        # Gather scalars as 1-element rows so the dim-0 slice gradient is
+        # well-defined; tf.reshape's own gradient restores the 0-d shape.
+        tensor = tf.reshape(tensor, [1])
+
+    @tf.custom_gradient
+    def _fn(x):
+        y = _collective(x, engine_mod.OP_ALLGATHER, name)
+
+        def grad(dy):
+            summed = _allreduce(dy)
+            d0 = tf.reshape(tf.shape(x, out_type=tf.int32)[0], [1])
+            sizes = tf.reshape(
+                _collective(d0, engine_mod.OP_ALLGATHER, None), [size()])
+            splits = tf.split(summed, num_or_size_splits=sizes, axis=0)
+            return splits[rank()]
+
+        return y, grad
+
+    return _fn(tensor)
+
+
+def broadcast(tensor, root_rank, name=None):
+    """Broadcast ``tensor`` from ``root_rank`` (reference mpi_ops.py:150-164).
+
+    Differentiable: grad = allreduce of the upstream grad, zeroed on
+    non-root ranks (reference mpi_ops.py:167-182).
+    """
+
+    @tf.custom_gradient
+    def _fn(x):
+        y = _collective(x, engine_mod.OP_BROADCAST, name, root_rank=root_rank)
+
+        def grad(dy):
+            reduced = _allreduce(dy)
+            if rank() != root_rank:
+                return reduced * 0
+            return reduced
+
+        return y, grad
+
+    return _fn(tf.convert_to_tensor(tensor))

@@ -15,12 +15,9 @@ Rebuild of reference horovod/torch/__init__.py:153-301:
 from __future__ import annotations
 
 import collections
-import pickle
 
-import numpy as np
 import torch
 
-from horovod_tpu import basics
 from horovod_tpu.torch import mpi_ops
 
 
@@ -123,19 +120,8 @@ def broadcast_optimizer_state(optimizer, root_rank: int = 0) -> None:
 
 
 def broadcast_object(obj, root_rank: int = 0):
-    """Pickle-based object broadcast across processes."""
-    if basics.size() == 1:
-        return obj
-    if basics.rank() == root_rank:
-        payload = np.frombuffer(pickle.dumps(obj), np.uint8).copy()
-        n = torch.tensor(len(payload))
-    else:
-        payload = None
-        n = torch.tensor(0)
-    n = int(mpi_ops.broadcast(n, root_rank, name="bcast_obj.len").item())
-    t = torch.from_numpy(payload) if payload is not None \
-        else torch.zeros(n, dtype=torch.uint8)
-    if t.numel() != n:
-        t = torch.zeros(n, dtype=torch.uint8)
-    out = mpi_ops.broadcast(t, root_rank, name="bcast_obj.payload")
-    return pickle.loads(out.numpy().tobytes())
+    """Pickle-based object broadcast across processes (shared engine-level
+    scheme, horovod_tpu/core/objects.py)."""
+    from horovod_tpu.core.objects import broadcast_object as _bo
+
+    return _bo(obj, root_rank, name="bcast_obj")

@@ -59,3 +59,14 @@ def test_jax_imagenet_resnet50(tmp_path):
                "--steps-per-epoch", "1", "--batch-size", "1",
                "--ckpt-dir", str(tmp_path / "r50"), timeout=560)
     assert "epoch 0" in out
+
+
+def test_tensorflow_mnist():
+    out = _run("tensorflow_mnist.py", "--epochs", "1", "--batch-size", "64")
+    assert "epoch 0" in out and "loss=" in out
+
+
+def test_tf_keras_mnist():
+    out = _run("tf_keras_mnist.py", "--epochs", "1", "--warmup-epochs", "1",
+               "--batch-size", "64")
+    assert "finished gradual learning rate warmup" in out

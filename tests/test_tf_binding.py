@@ -1,0 +1,257 @@
+"""TensorFlow binding tests — mirrors the reference TF matrix
+(reference test/test_tensorflow.py + test/test_keras.py): collectives
+round-trip, gradients of all three ops, IndexedSlices sparse path,
+compression, tf.function compatibility, DistributedOptimizer /
+DistributedGradientTape training, broadcast_variables, keras callbacks
+(metric averaging, warmup, momentum correction), load_model round-trip."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+import keras  # noqa: E402
+
+import horovod_tpu.tensorflow as hvd_tf  # noqa: E402
+import horovod_tpu.tensorflow.keras as hvd_keras  # noqa: E402
+
+
+@pytest.fixture()
+def hvdtf(hvd):
+    # hvd fixture ensures init (single process, 8 virtual chips)
+    return hvd_tf
+
+
+def test_allreduce_roundtrip(hvdtf):
+    x = tf.constant([[1.0, 2.0], [3.0, 4.0]])
+    out = hvdtf.allreduce(x, average=True)
+    np.testing.assert_allclose(out.numpy(), x.numpy())
+    out = hvdtf.allreduce(x, average=False)
+    np.testing.assert_allclose(out.numpy(), x.numpy() * hvdtf.size())
+
+
+def test_allreduce_bf16(hvdtf):
+    x = tf.cast(tf.linspace(-2.0, 2.0, 8), tf.bfloat16)
+    out = hvdtf.allreduce(x, average=False)
+    assert out.dtype == tf.bfloat16
+    np.testing.assert_allclose(tf.cast(out, tf.float32).numpy(),
+                               tf.cast(x, tf.float32).numpy())
+
+
+def test_allreduce_fp16_compression(hvdtf):
+    x = tf.linspace(-1.0, 1.0, 8)
+    out = hvdtf.allreduce(x, average=False,
+                          compression=hvd_tf.Compression.fp16)
+    assert out.dtype == tf.float32
+    np.testing.assert_allclose(out.numpy(), x.numpy(), atol=1e-2)
+
+
+def test_allreduce_int_average_truncates(hvdtf):
+    x = tf.constant([3, 5], tf.int32)
+    out = hvdtf.allreduce(x, average=True)
+    assert out.dtype == tf.int32
+    np.testing.assert_array_equal(out.numpy(), [3, 5])  # size 1
+
+
+def test_allgather_and_broadcast(hvdtf):
+    x = tf.reshape(tf.range(6, dtype=tf.float32), (2, 3))
+    np.testing.assert_allclose(hvdtf.allgather(x).numpy(), x.numpy())
+    np.testing.assert_allclose(hvdtf.broadcast(x, 0).numpy(), x.numpy())
+
+
+def test_broadcast_scalar(hvdtf):
+    s = tf.constant(5.0)
+    out = hvdtf.broadcast(s, 0)
+    assert out.shape == ()
+    assert float(out) == 5.0
+
+
+def test_allreduce_grad(hvdtf):
+    v = tf.Variable([1.0, 2.0])
+    with tf.GradientTape() as tape:
+        y = hvdtf.allreduce(v, average=True)
+        loss = tf.reduce_sum(y * y)
+    g = tape.gradient(loss, v)
+    # d/dv sum((v)^2) = 2v at size 1
+    np.testing.assert_allclose(g.numpy(), 2 * v.numpy())
+
+
+def test_allgather_grad(hvdtf):
+    v = tf.Variable([[1.0], [2.0]])
+    with tf.GradientTape() as tape:
+        y = hvdtf.allgather(v)
+        loss = tf.reduce_sum(3.0 * y)
+    g = tape.gradient(loss, v)
+    # grad = allreduce(dy) sliced back to the local rows = 3s
+    np.testing.assert_allclose(g.numpy(), np.full((2, 1), 3.0))
+
+
+def test_broadcast_grad(hvdtf):
+    v = tf.Variable([1.0, 2.0, 3.0])
+    with tf.GradientTape() as tape:
+        y = hvdtf.broadcast(v, 0)
+        loss = tf.reduce_sum(y)
+    g = tape.gradient(loss, v)
+    # rank 0 == root keeps the reduced grad (reference mpi_ops.py:167-182)
+    np.testing.assert_allclose(g.numpy(), np.ones(3))
+
+
+def test_sparse_indexed_slices(hvdtf):
+    s = tf.IndexedSlices(values=tf.constant([[1.0, 2.0], [3.0, 4.0]]),
+                         indices=tf.constant([0, 2]),
+                         dense_shape=tf.constant([4, 2]))
+    out = hvdtf.allreduce(s, average=True)
+    assert isinstance(out, tf.IndexedSlices)
+    np.testing.assert_allclose(out.values.numpy(), s.values.numpy())
+    np.testing.assert_array_equal(out.indices.numpy(), s.indices.numpy())
+
+
+def test_tf_function_graph_mode(hvdtf):
+    @tf.function
+    def fused(a, b):
+        return hvdtf.allreduce(a, average=False), hvdtf.allreduce(
+            b, average=False)
+
+    a, b = fused(tf.constant([1.0]), tf.constant([2.0, 3.0]))
+    np.testing.assert_allclose(a.numpy(), [1.0])
+    np.testing.assert_allclose(b.numpy(), [2.0, 3.0])
+
+
+def test_distributed_gradient_tape(hvdtf):
+    v = tf.Variable([2.0])
+    with hvd_tf.DistributedGradientTape(tf.GradientTape()) as tape:
+        loss = tf.reduce_sum(v * v)
+    g = tape.gradient(loss, [v])
+    np.testing.assert_allclose(g[0].numpy(), [4.0])
+
+
+def test_broadcast_variables(hvdtf):
+    v1 = tf.Variable([1.0, 2.0])
+    v2 = tf.Variable(3.0)
+    hvdtf.broadcast_variables([v1, v2], root_rank=0)
+    np.testing.assert_allclose(v1.numpy(), [1.0, 2.0])
+    assert float(v2) == 3.0
+
+
+def test_broadcast_object(hvdtf):
+    obj = {"epoch": 3, "best": 0.91}
+    assert hvdtf.broadcast_object(obj, root_rank=0) == obj
+
+
+def test_broadcast_global_variables_eager_raises(hvdtf):
+    with pytest.raises(RuntimeError, match="broadcast_variables"):
+        hvdtf.broadcast_global_variables(0)
+
+
+def _model_and_data(seed=0):
+    np.random.seed(seed)
+    keras.utils.set_random_seed(seed)
+    x = np.random.rand(128, 8).astype("float32")
+    y = (x.sum(1) > 4).astype("int32")
+    model = keras.Sequential([keras.layers.Dense(16, activation="relu"),
+                              keras.layers.Dense(2)])
+    return model, x, y
+
+
+def test_keras_distributed_optimizer_trains(hvdtf):
+    model, x, y = _model_and_data()
+    opt = hvd_keras.DistributedOptimizer(keras.optimizers.SGD(0.1))
+    model.compile(optimizer=opt, loss="sparse_categorical_crossentropy",
+                  jit_compile=False)
+    hist = model.fit(x, y, epochs=3, batch_size=32, verbose=0)
+    assert hist.history["loss"][-1] < hist.history["loss"][0]
+
+
+def test_keras_embedding_sparse_path(hvdtf):
+    keras.utils.set_random_seed(0)
+    model = keras.Sequential([keras.layers.Embedding(50, 8),
+                              keras.layers.Flatten(),
+                              keras.layers.Dense(1)])
+    model.compile(
+        optimizer=hvd_keras.DistributedOptimizer(keras.optimizers.SGD(0.1)),
+        loss="mse", jit_compile=False)
+    xi = np.random.randint(0, 50, (64, 4))
+    yi = np.random.rand(64, 1).astype("float32")
+    hist = model.fit(xi, yi, epochs=2, batch_size=16, verbose=0)
+    assert hist.history["loss"][-1] <= hist.history["loss"][0]
+
+
+def test_keras_sparse_as_dense(hvdtf):
+    keras.utils.set_random_seed(0)
+    model = keras.Sequential([keras.layers.Embedding(20, 4),
+                              keras.layers.Flatten(),
+                              keras.layers.Dense(1)])
+    model.compile(
+        optimizer=hvd_keras.DistributedOptimizer(
+            keras.optimizers.SGD(0.1), sparse_as_dense=True),
+        loss="mse", jit_compile=False)
+    xi = np.random.randint(0, 20, (32, 4))
+    yi = np.random.rand(32, 1).astype("float32")
+    model.fit(xi, yi, epochs=1, batch_size=16, verbose=0)
+
+
+def test_keras_callbacks_fit(hvdtf):
+    model, x, y = _model_and_data()
+    opt = hvd_keras.DistributedOptimizer(
+        keras.optimizers.SGD(0.1, momentum=0.9))
+    model.compile(optimizer=opt, loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], jit_compile=False)
+    cbs = [hvd_keras.callbacks.BroadcastGlobalVariablesCallback(0),
+           hvd_keras.callbacks.MetricAverageCallback(),
+           hvd_keras.callbacks.LearningRateWarmupCallback(
+               warmup_epochs=2),
+           hvd_keras.callbacks.LearningRateScheduleCallback(
+               multiplier=0.5, start_epoch=2)]
+    hist = model.fit(x, y, epochs=3, batch_size=32, callbacks=cbs, verbose=0)
+    # schedule epoch applies initial_lr * 0.5 (size==1 so warmup is flat)
+    assert hist.history["lr"][-1] == pytest.approx(0.05, rel=1e-5)
+
+
+def test_momentum_correction_scales_velocity(hvdtf):
+    model, x, y = _model_and_data()
+    opt = hvd_keras.DistributedOptimizer(
+        keras.optimizers.SGD(0.1, momentum=0.9))
+    model.compile(optimizer=opt, loss="sparse_categorical_crossentropy",
+                  jit_compile=False)
+    model.fit(x, y, epochs=1, batch_size=32, verbose=0)  # builds velocity
+    cb = hvd_keras.callbacks.LearningRateScheduleCallback(
+        multiplier=0.5, momentum_correction=True)
+    cb.set_model(model)
+    cb.on_train_begin()
+    before = [v.numpy().copy() for v in model.optimizer.momentums]
+    assert any(np.abs(b).sum() > 0 for b in before)
+    cb._adjust_learning_rate(epoch=0)
+    after = [v.numpy() for v in model.optimizer.momentums]
+    for b, a in zip(before, after):
+        np.testing.assert_allclose(a, b * 0.5, rtol=1e-6)
+
+
+def test_keras_load_model_rewraps_optimizer(hvdtf, tmp_path):
+    model, x, y = _model_and_data()
+    opt = hvd_keras.DistributedOptimizer(keras.optimizers.SGD(0.1))
+    model.compile(optimizer=opt, loss="sparse_categorical_crossentropy",
+                  jit_compile=False)
+    model.fit(x, y, epochs=1, batch_size=32, verbose=0)
+    path = str(tmp_path / "model.keras")
+    model.save(path)
+    loaded = hvd_keras.load_model(path)
+    assert type(loaded.optimizer).__name__ == "DistributedSGD"
+    # resumed training still goes through the allreduce path
+    loaded.fit(x, y, epochs=1, batch_size=32, verbose=0)
+    np.testing.assert_allclose(loaded.predict(x[:4], verbose=0).shape,
+                               (4, 2))
+
+
+def test_v1_distributed_optimizer_wraps(hvdtf):
+    base = tf.compat.v1.train.GradientDescentOptimizer(0.1)
+    opt = hvd_tf.DistributedOptimizer(base)
+    assert opt.get_slot_names() == base.get_slot_names()
+
+
+def test_allgather_scalar_grad(hvdtf):
+    v = tf.Variable(2.0)
+    with tf.GradientTape() as tape:
+        y = hvdtf.allgather(v)
+        loss = tf.reduce_sum(y)
+    g = tape.gradient(loss, v)
+    assert g.shape == ()
+    assert float(g) == 1.0
