@@ -1,0 +1,121 @@
+"""Transformer training throughput + MFU harness.
+
+Companion to examples/jax_synthetic_benchmark.py (the ResNet harness that
+mirrors reference examples/pytorch_synthetic_benchmark.py:14-107): synthetic
+token data, full train step (fwd + bwd + adamw), hard-sync timing windows,
+reports tokens/sec and model FLOPs utilization.
+
+MFU accounting (PaLM appendix-B style): train FLOPs/token ≈ 6·N_params
++ 6·L·S·E for causal attention (12·L·S·E for full attention — the causal
+mask halves the realized score/value matmul work).  Peak is v5e bf16
+(197 TFLOP/s) unless --peak-tflops overrides.
+
+Run (real chip):   python examples/jax_transformer_benchmark.py
+Long-context:      python examples/jax_transformer_benchmark.py \
+                       --seq-len 32768 --batch 1 --layers 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.models import Transformer, TransformerConfig
+from horovod_tpu.ops.flash_attention import make_flash_attention
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--layers", type=int, default=12)
+    ap.add_argument("--embed", type=int, default=768)
+    ap.add_argument("--heads", type=int, default=12)
+    ap.add_argument("--seq-len", type=int, default=1024)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--vocab", type=int, default=32000)
+    ap.add_argument("--num-warmup-batches", type=int, default=3)
+    ap.add_argument("--num-iters", type=int, default=5)
+    ap.add_argument("--num-batches-per-iter", type=int, default=5)
+    ap.add_argument("--no-flash", action="store_true",
+                    help="dense einsum attention (for comparison / to "
+                         "demonstrate where it OOMs)")
+    ap.add_argument("--block-q", type=int, default=512)
+    ap.add_argument("--block-k", type=int, default=512)
+    ap.add_argument("--peak-tflops", type=float, default=197.0,
+                    help="bf16 peak of the chip (v5e default)")
+    args = ap.parse_args()
+
+    hvd.init()
+    cfg = dict(vocab_size=args.vocab, num_layers=args.layers,
+               num_heads=args.heads, head_dim=args.embed // args.heads,
+               embed_dim=args.embed, mlp_dim=4 * args.embed,
+               max_seq_len=args.seq_len, dtype=jnp.bfloat16)
+    attn = None if args.no_flash else make_flash_attention(
+        block_q=args.block_q, block_k=args.block_k)
+    model = Transformer(TransformerConfig(
+        **cfg, **({"attention_fn": attn} if attn else {})))
+    init_model = Transformer(TransformerConfig(**cfg))
+
+    params = init_model.init(jax.random.PRNGKey(0),
+                             jnp.zeros((1, args.seq_len), jnp.int32))
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    opt = optax.adamw(3e-4)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def train_step(params, opt_state, tokens):
+        def loss_fn(p):
+            logits = model.apply(p, tokens)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], tokens[:, 1:]).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, args.vocab,
+                                     (args.batch, args.seq_len)))
+
+    loss = None
+    for _ in range(args.num_warmup_batches):
+        params, opt_state, loss = train_step(params, opt_state, tokens)
+    float(loss)  # hard sync (tunneled backends return early otherwise)
+
+    rates = []
+    for _ in range(args.num_iters):
+        t0 = time.perf_counter()
+        for _ in range(args.num_batches_per_iter):
+            params, opt_state, loss = train_step(params, opt_state, tokens)
+        float(loss)
+        dt = time.perf_counter() - t0
+        rates.append(args.batch * args.seq_len
+                     * args.num_batches_per_iter / dt)
+
+    tok_s = float(np.mean(rates))
+    # 6N matmul FLOPs/token + causal attention FLOPs/token.
+    flops_per_token = (6 * n_params
+                       + 6 * args.layers * args.seq_len * args.embed)
+    mfu = tok_s * flops_per_token / (args.peak_tflops * 1e12)
+    step_ms = (args.batch * args.seq_len / tok_s) * 1e3
+    if hvd.rank() == 0:
+        print(json.dumps({
+            "metric": "transformer_train_throughput",
+            "params_m": round(n_params / 1e6, 1),
+            "seq_len": args.seq_len,
+            "batch": args.batch,
+            "tok_per_s": round(tok_s, 1),
+            "step_ms": round(step_ms, 1),
+            "mfu": round(mfu, 4),
+            "flash": not args.no_flash,
+        }))
+
+
+if __name__ == "__main__":
+    main()

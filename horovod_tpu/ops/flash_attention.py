@@ -12,10 +12,12 @@ Integration points:
 * ``parallel/ring_attention.py`` can use it per ring step (each step is
   exactly one q-block × local-K/V attention with carried (m, l, acc)).
 
-Backward runs via recomputation with the reference einsum implementation
-(O(S²) transient in the cotangent pass only) under ``jax.custom_vjp`` — a
-fused backward kernel is a further optimization, the forward is where
-inference/serving and activation memory win.
+Backward is fused too: a dq kernel (grid over q-blocks) and a dk/dv kernel
+(grid over k-blocks) recompute probabilities per block from the forward's
+saved log-sum-exp — p = exp(s − lse) — and carry Δ = rowsum(dO·O), the
+standard flash-attention backward.  No O(S²) tensor is ever materialized in
+HBM in either pass.  The kernels take lse/Δ as explicit inputs so ring
+attention can drive them per ring step with globally-merged statistics.
 
 Non-TPU backends fall back to Pallas interpret mode (tests) so numerics are
 identical everywhere.
@@ -82,8 +84,10 @@ def _flash_kernel(meta_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
     # log-sum-exp per query row (NEG_INF where a row attended to nothing) —
     # lets callers combine partial attentions exactly (ring attention).
+    # Stored sublane-replicated (8, block_q): Mosaic requires the last two
+    # block dims be (8k, 128k)-tileable, which a (1, block_q) row is not.
     lse = jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-30)), NEG_INF)
-    lse_ref[0] = lse[:, 0]
+    lse_ref[0] = jnp.broadcast_to(lse[:, 0][None, :], lse_ref.shape[1:])
 
 
 def _pad_to(x, axis, multiple):
@@ -130,20 +134,199 @@ def _flash_forward(q, k, v, causal, q_offset, k_offset, block_q, block_k,
         ],
         out_specs=(
             pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, qi: (bh, qi)),
+            pl.BlockSpec((1, 8, block_q), lambda bh, qi: (bh, 0, qi)),
         ),
         out_shape=(
             jax.ShapeDtypeStruct(qb.shape, q.dtype),
-            jax.ShapeDtypeStruct(qb.shape[:2], jnp.float32),
+            jax.ShapeDtypeStruct((qb.shape[0], 8, qb.shape[1]),
+                                 jnp.float32),
         ),
         interpret=interpret,
     )(meta, qb, kb, vb)
     out = out[:, :s_q].reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
     if with_lse:
-        # [B·H, S] → [B, S, H]
-        lse = lse[:, :s_q].reshape(b, h, s_q).transpose(0, 2, 1)
+        # [B·H, 8, S] (sublane-replicated) → [B, S, H]
+        lse = lse[:, 0, :s_q].reshape(b, h, s_q).transpose(0, 2, 1)
         return out, lse
     return out
+
+
+def _bwd_dq_kernel(meta_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, *, block_q: int, block_k: int, num_k_blocks: int,
+                   causal: bool, scale: float):
+    """One (batch·head, q-block) program: dq = Σ_k  p·(dp − Δ) · K · scale."""
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale              # [bq, D]
+    do = do_ref[0].astype(jnp.float32)                    # [bq, D]
+    lse = lse_ref[0, 0, :][:, None]                       # [bq, 1]
+    delta = delta_ref[0, 0, :][:, None]
+    row_ok = lse > NEG_INF / 2                            # rows that attended
+    q_pos = (meta_ref[0] + qi * block_q
+             + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0))
+
+    def body(ki, dq):
+        k = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        k_pos = (meta_ref[1] + ki * block_k
+                 + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1))
+        mask = k_pos < meta_ref[2]
+        if causal:
+            mask = jnp.logical_and(mask, q_pos >= k_pos)
+        p = jnp.where(jnp.logical_and(mask, row_ok), jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return dq + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(
+        0, num_k_blocks, body, jnp.zeros((block_q, q.shape[-1]), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(meta_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, block_q: int, block_k: int,
+                    num_q_blocks: int, causal: bool, scale: float):
+    """One (batch·head, k-block) program:
+    dv = Σ_q pᵀ·dO;  dk = Σ_q (p·(dp − Δ))ᵀ · (q·scale)."""
+    ki = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)                      # [bk, D]
+    v = v_ref[0].astype(jnp.float32)
+    d = k.shape[-1]
+    k_pos = (meta_ref[1] + ki * block_k
+             + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1))
+    k_valid = k_pos < meta_ref[2]
+
+    def body(qi, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(
+            jnp.float32) * scale
+        do = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)][:, None]
+        delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)][:, None]
+        row_ok = lse > NEG_INF / 2
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        q_pos = (meta_ref[0] + qi * block_q
+                 + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0))
+        mask = k_valid
+        if causal:
+            mask = jnp.logical_and(mask, q_pos >= k_pos)
+        p = jnp.where(jnp.logical_and(mask, row_ok), jnp.exp(s - lse), 0.0)
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        # q is pre-scaled, so this IS d s/d k contracted with ds.
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk0 = jnp.zeros((block_k, d), jnp.float32)
+    dv0 = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(0, num_q_blocks, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def flash_attention_backward(q, k, v, dout, lse, delta, causal,
+                             q_offset, k_offset, block_q, block_k,
+                             interpret):
+    """Fused backward: (dq, dk, dv) from saved lse and Δ = rowsum(dO·O).
+
+    ``lse``/``delta``: [B, S_q, H] float32 — from ``_flash_forward(...,
+    with_lse=True)`` (or the ring's globally-merged statistics), so the
+    per-block probabilities recompute exactly without an O(S²) tensor.
+    """
+    b, s_q, h, d = q.shape
+    s_k = k.shape[1]
+    scale = d ** -0.5
+
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+    def to_bh2(x):  # [B, S, H] → [B·H, S]
+        return x.transpose(0, 2, 1).reshape(b * h, x.shape[1])
+
+    qb = _pad_to(to_bh(q), 1, block_q)
+    dob = _pad_to(to_bh(dout.astype(q.dtype)), 1, block_q)
+    kb = _pad_to(to_bh(k), 1, block_k)
+    vb = _pad_to(to_bh(v), 1, block_k)
+    # Padded q rows get lse = +inf-ish so p = exp(s − lse) = 0 there.
+    # Both vectors are stored sublane-replicated [B·H, 8, S] (Mosaic tiling
+    # constraint — see the forward's lse output).
+    lse_b = jnp.pad(to_bh2(lse.astype(jnp.float32)),
+                    ((0, 0), (0, qb.shape[1] - s_q)),
+                    constant_values=-NEG_INF)
+    lse_b = jnp.broadcast_to(lse_b[:, None, :],
+                             (lse_b.shape[0], 8, lse_b.shape[1]))
+    delta_b = _pad_to(to_bh2(delta.astype(jnp.float32)), 1, block_q)
+    delta_b = jnp.broadcast_to(delta_b[:, None, :],
+                               (delta_b.shape[0], 8, delta_b.shape[1]))
+    num_q_blocks = qb.shape[1] // block_q
+    num_k_blocks = kb.shape[1] // block_k
+    meta = jnp.asarray(
+        [jnp.asarray(q_offset, jnp.int32),
+         jnp.asarray(k_offset, jnp.int32),
+         jnp.asarray(k_offset, jnp.int32) + s_k], jnp.int32)
+    smem = {"memory_space": _SMEM} if _SMEM is not None else {}
+
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel, block_q=block_q, block_k=block_k,
+        num_k_blocks=num_k_blocks, causal=causal, scale=scale)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(b * h, num_q_blocks),
+        in_specs=[
+            pl.BlockSpec((3,), lambda bh, qi: (0,), **smem),
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, kb.shape[1], d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, kb.shape[1], d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, 8, block_q), lambda bh, qi: (bh, 0, qi)),
+            pl.BlockSpec((1, 8, block_q), lambda bh, qi: (bh, 0, qi)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct(qb.shape, q.dtype),
+        interpret=interpret,
+    )(meta, qb, kb, vb, dob, lse_b, delta_b)
+
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel, block_q=block_q, block_k=block_k,
+        num_q_blocks=num_q_blocks, causal=causal, scale=scale)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(b * h, num_k_blocks),
+        in_specs=[
+            pl.BlockSpec((3,), lambda bh, ki: (0,), **smem),
+            pl.BlockSpec((1, qb.shape[1], d), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, qb.shape[1], d), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, 8, qb.shape[1]), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, 8, qb.shape[1]), lambda bh, ki: (bh, 0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct(kb.shape, k.dtype),
+            jax.ShapeDtypeStruct(vb.shape, v.dtype),
+        ),
+        interpret=interpret,
+    )(meta, qb, kb, vb, dob, lse_b, delta_b)
+
+    def from_bh(x, s):
+        return x[:, :s].reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+    return from_bh(dq, s_q), from_bh(dk, s_k), from_bh(dv, s_k)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 6, 7, 8))
@@ -152,33 +335,21 @@ def _flash(q, k, v, causal, q_offset, k_offset, block_q, block_k, interpret):
                           block_k, interpret)
 
 
-def _reference(q, k, v, causal, q_offset, k_offset):
-    """Einsum attention with global-position causal masking (matches the
-    kernel's semantics; used for the recompute backward)."""
-    scale = q.shape[-1] ** -0.5
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                   preferred_element_type=jnp.float32) * scale
-    q_pos = q_offset + jnp.arange(q.shape[1])[:, None]
-    k_pos = k_offset + jnp.arange(k.shape[1])[None, :]
-    if causal:
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
-
-
 def _flash_fwd(q, k, v, causal, q_offset, k_offset, block_q, block_k,
                interpret):
-    out = _flash_forward(q, k, v, causal, q_offset, k_offset, block_q,
-                         block_k, interpret)
-    return out, (q, k, v, q_offset, k_offset)
+    out, lse = _flash_forward(q, k, v, causal, q_offset, k_offset, block_q,
+                              block_k, interpret, with_lse=True)
+    return out, (q, k, v, out, lse, q_offset, k_offset)
 
 
 def _flash_bwd(causal, block_q, block_k, interpret, res, g):
-    q, k, v, q_offset, k_offset = res
-    _, vjp = jax.vjp(
-        lambda q, k, v: _reference(q, k, v, causal, q_offset, k_offset),
-        q, k, v)
-    dq, dk, dv = vjp(g)
+    q, k, v, out, lse, q_offset, k_offset = res
+    # Δ = rowsum(dO·O) — the softmax-normalization term of the backward.
+    # [B, S, H, D] → [B, S, H], matching the lse layout.
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    dq, dk, dv = flash_attention_backward(
+        q, k, v, g, lse, delta, causal, q_offset, k_offset, block_q,
+        block_k, interpret)
     return dq, dk, dv, None, None
 
 
@@ -210,7 +381,8 @@ def flash_attention_with_lse(q, k, v, causal: bool = True, q_offset=0,
     ``lse[b, s, h] = logsumexp_k(q·kᵀ·scale)`` (NEG_INF for rows that
     attended to nothing) — the combiner state ring attention needs to merge
     partial attentions over K/V blocks exactly.  Differentiation is handled
-    by the caller (ring attention recomputes per-block under its own vjp).
+    by the caller (ring attention drives ``flash_attention_backward`` per
+    ring step with the globally-merged lse under its own vjp).
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"

@@ -98,19 +98,8 @@ def make_ring_attention(axis_name: str):
 # Ring + flash: Pallas kernel inside each ring step
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def ring_flash_attention(q, k, v, axis_name: str, causal: bool = True,
-                         block_q: int = 128, block_k: int = 128):
-    """Ring attention whose per-step block attention is the fused Pallas
-    flash kernel (ops/flash_attention.py), merged across steps with exact
-    log-sum-exp combining.
-
-    Versus :func:`ring_attention` (einsum blocks): per-step peak memory
-    drops from O(S_local²) logits to O(S_local·D), so the maximum
-    per-chip sequence shard is set by K/V residency, not by the score
-    matrix.  Backward recomputes through the einsum ring (exact, O(S_local²)
-    transient in the cotangent pass only).
-    """
+def _ring_flash_forward(q, k, v, axis_name, causal, block_q, block_k):
+    """Forward ring pass; returns (out_f32, merged lse)."""
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
@@ -140,21 +129,74 @@ def ring_flash_attention(q, k, v, axis_name: str, causal: bool = True,
         v = lax.ppermute(v, axis_name, perm)
         return (k, v, out, lse), None
 
-    (_, _, out, _), _ = lax.scan(step, (k, v, out, lse), jnp.arange(n))
+    (_, _, out, lse), _ = lax.scan(step, (k, v, out, lse), jnp.arange(n))
+    return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def ring_flash_attention(q, k, v, axis_name: str, causal: bool = True,
+                         block_q: int = 128, block_k: int = 128):
+    """Ring attention whose per-step block attention is the fused Pallas
+    flash kernel (ops/flash_attention.py), merged across steps with exact
+    log-sum-exp combining.
+
+    Versus :func:`ring_attention` (einsum blocks): per-step peak memory
+    drops from O(S_local²) logits to O(S_local·D), so the maximum
+    per-chip sequence shard is set by K/V residency, not by the score
+    matrix.  Backward is a second ring pass over the fused Pallas backward
+    kernels, driven by the globally-merged log-sum-exp — dq accumulates
+    locally while dk/dv ride the ring with their K/V blocks, so the
+    cotangent pass is O(S_local·D) memory too (no O(S²) transient).
+    """
+    out, _ = _ring_flash_forward(q, k, v, axis_name, causal, block_q,
+                                 block_k)
     return out.astype(q.dtype)
 
 
 def _ring_flash_fwd(q, k, v, axis_name, causal, block_q, block_k):
-    out = ring_flash_attention(q, k, v, axis_name, causal, block_q, block_k)
-    return out, (q, k, v)
+    out, lse = _ring_flash_forward(q, k, v, axis_name, causal, block_q,
+                                   block_k)
+    return out.astype(q.dtype), (q, k, v, out, lse)
 
 
 def _ring_flash_bwd(axis_name, causal, block_q, block_k, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q, k, v: ring_attention(q, k, v, axis_name, causal=causal),
-        q, k, v)
-    return vjp(g)
+    from horovod_tpu.ops.flash_attention import flash_attention_backward
+
+    q, k, v, out, lse = res
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+    # Δ = rowsum(dO·O) with the FINAL (globally merged) output — valid for
+    # every block because p recomputes against the merged lse.
+    delta = jnp.sum(g.astype(jnp.float32) * out, axis=-1)  # [B, S, H]
+    interpret = jax.default_backend() != "tpu"
+
+    varying = functools.partial(lax.pcast, axis_name=axis_name, to="varying")
+    dq = varying(jnp.zeros(q.shape, jnp.float32))
+    dk = varying(jnp.zeros(k.shape, jnp.float32))
+    dv = varying(jnp.zeros(v.shape, jnp.float32))
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, i):
+        k, v, dk, dv, dq = carry
+        owner = (my - i) % n
+        dq_i, dk_i, dv_i = flash_attention_backward(
+            q, k, v, g, lse, delta, causal,
+            my * s_local, owner * s_local, block_q, block_k, interpret)
+        dq = dq + dq_i.astype(jnp.float32)
+        dk = dk + dk_i.astype(jnp.float32)
+        dv = dv + dv_i.astype(jnp.float32)
+        # dk/dv travel WITH their K/V blocks: after n rotations both the
+        # blocks and their accumulated gradients are home.
+        k = lax.ppermute(k, axis_name, perm)
+        v = lax.ppermute(v, axis_name, perm)
+        dk = lax.ppermute(dk, axis_name, perm)
+        dv = lax.ppermute(dv, axis_name, perm)
+        return (k, v, dk, dv, dq), None
+
+    (_, _, dk, dv, dq), _ = lax.scan(
+        step, (k, v, varying(dk), varying(dv), varying(dq)), jnp.arange(n))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 ring_flash_attention.defvjp(_ring_flash_fwd, _ring_flash_bwd)
