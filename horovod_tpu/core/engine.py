@@ -259,11 +259,14 @@ class NativeEngine:
         with self._store_lock:
             result = self._results.pop(handle, None)
             entry = self._handle_names.pop(handle, None)
-            if rc != STATUS_OK and entry is not None:
-                # On errors no executor ever took the input; free the name so
-                # later enqueues aren't rejected as duplicates — but only if
-                # the stored array is still OURS (a newer request may have
-                # legally reused the name after this handle failed).
+            if entry is not None and (rc != STATUS_OK or result is None):
+                # Two cases leave the staged input orphaned in _store: errors
+                # (no executor ever took the input) and natively-finalized ops
+                # (BARRIER completes inside DispatchResponses without any
+                # executor calling take_inputs).  Free the name so later
+                # enqueues aren't rejected as duplicates — but only if the
+                # stored array is still OURS (a newer request may have
+                # legally reused the name after this handle finished).
                 name, arr = entry
                 if self._store.get(name) is arr:
                     self._store.pop(name, None)

@@ -15,7 +15,7 @@ import jax
 import optax
 from flax.training import train_state
 
-from horovod_tpu import basics, checkpoint, training
+from horovod_tpu import checkpoint, training
 from horovod_tpu.callbacks import (  # noqa: F401 - re-export, keras parity
     BroadcastGlobalVariablesCallback,
     LearningRateScheduleCallback,
@@ -58,7 +58,10 @@ def load_model(path, *, apply_fn, tx: optax.GradientTransformation,
     freshly distributed-wrapped ``tx`` and broadcast, mirroring
     ``hvd.load_model``'s custom_objects re-wrapping (reference
     keras/__init__.py:115-148) and broadcast-after-load consistency."""
-    raw = checkpoint.restore(path, broadcast=False)
+    # Only rank 0 touches the filesystem (checkpoint.py's stale-FS
+    # contract); the raw tree arrives on other ranks via the broadcast
+    # built into restore(), so no separate re-broadcast is needed.
+    raw = checkpoint.restore(path)
     state = TrainState.create_distributed(
         apply_fn=apply_fn, params=raw["params"], tx=tx,
         compression=compression)
@@ -72,8 +75,4 @@ def load_model(path, *, apply_fn, tx: optax.GradientTransformation,
         # Optimizer hyperparameters changed shape — keep fresh opt state,
         # params still restored (same leniency as Keras custom_objects path).
         pass
-    if basics.size() > 1:
-        state = state.replace(
-            params=training.broadcast_parameters(state.params),
-            opt_state=training.broadcast_optimizer_state(state.opt_state))
     return state

@@ -41,6 +41,14 @@ def allreduce_async(tensor, average: bool = True, name: str | None = None,
     torch/mpi_ops.py:69-107)."""
     eng = engine_mod.get_engine()
     arr = np.asarray(tensor)
+    if average and arr.dtype.kind in "iub":
+        # Integer division would silently truncate toward zero; modern
+        # reference builds reject this combination outright rather than
+        # return lossy results (the torch binding averages int tensors
+        # itself with an explicit documented rounding mode).
+        raise ValueError(
+            f"allreduce(average=True) is not supported for integer dtype "
+            f"{arr.dtype}; use average=False and divide explicitly.")
     compressed, ctx = compression.compress(arr)
     compressed = np.asarray(compressed)
     h = eng.enqueue(_auto_name("allreduce", name), compressed,

@@ -47,16 +47,38 @@ from horovod_tpu.ops import fusion
 Average = True  # default matches reference allreduce(average=True)
 
 
+def _private_axis_env_names() -> tuple[str, ...]:
+    """The one touch of private JAX API, isolated so tests can simulate its
+    drift (symbol renamed/removed) without disturbing jax internals."""
+    from jax._src import core as _core
+    return tuple(_core.get_axis_env().axis_sizes.keys())
+
+
 def _bound_axis_names() -> tuple[str, ...]:
     """Mesh axis names bound by an enclosing shard_map/pmap trace."""
     try:
-        from jax._src import core as _core
-        env = _core.get_axis_env()
-        return tuple(env.axis_sizes.keys())
-    except Exception:  # pragma: no cover - private-API drift fallback
+        return tuple(_private_axis_env_names())
+    except Exception:  # private-API drift fallback
+        # Probe every axis name we could plausibly be traced under: the
+        # horovod_tpu conventions AND the axes of whatever mesh is active —
+        # both our global mesh and jax's thread-local physical mesh — so a
+        # shard_map over a custom user mesh (axis named neither "hvd" nor
+        # "dcn"/"ici") still gets in-mesh semantics if this private API ever
+        # drifts (pinned by tests/test_mesh_axes.py).
+        candidates = [*mesh.data_axes(), mesh.DATA_AXIS, mesh.DCN_AXIS,
+                      mesh.ICI_AXIS]
+        try:
+            candidates.extend(mesh.global_mesh().axis_names)
+        except Exception:
+            pass
+        try:
+            from jax._src import mesh as _jmesh
+            active = _jmesh.thread_resources.env.physical_mesh
+            candidates.extend(active.axis_names)
+        except Exception:
+            pass
         found = []
-        for name in (*mesh.data_axes(), mesh.DATA_AXIS, mesh.DCN_AXIS,
-                     mesh.ICI_AXIS):
+        for name in candidates:
             try:
                 lax.axis_size(name)
                 found.append(name)

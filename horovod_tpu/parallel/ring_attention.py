@@ -195,7 +195,7 @@ def _ring_flash_bwd(axis_name, causal, block_q, block_k, res, g):
         return (k, v, dk, dv, dq), None
 
     (_, _, dk, dv, dq), _ = lax.scan(
-        step, (k, v, varying(dk), varying(dv), varying(dq)), jnp.arange(n))
+        step, (k, v, dk, dv, dq), jnp.arange(n))
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 

@@ -50,6 +50,28 @@ def test_barrier(hvd):
     hvd.barrier()  # single process: completes once negotiated
 
 
+def test_barrier_name_reusable(hvd):
+    # Barriers are finalized natively (no executor takes their staged
+    # input); synchronize must still free the name or the second call
+    # would be rejected as a duplicate (advisor round-1 finding).
+    hvd.barrier(name="sync")
+    hvd.barrier(name="sync")
+
+
+def test_barrier_does_not_leak_store(hvd):
+    from horovod_tpu.core import engine as engine_mod
+
+    eng = engine_mod.get_engine()
+    for _ in range(5):
+        hvd.barrier()
+    assert not eng._store, f"leaked store entries: {list(eng._store)}"
+
+
+def test_allreduce_average_int_raises(hvd):
+    with pytest.raises(ValueError, match="integer"):
+        hvd.allreduce_async(np.ones(4, np.int32), average=True, name="i0")
+
+
 def test_keras_alias(hvd):
     import horovod_tpu.keras as hvd_keras
 
