@@ -48,6 +48,8 @@ from horovod_tpu.ops import (  # noqa: F401
     allreduce,
     allreduce_async,
     allreduce_sparse,
+    alltoall,
+    alltoall_async,
     barrier,
     batch_spec,
     broadcast,

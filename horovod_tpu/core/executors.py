@@ -41,6 +41,28 @@ def local_executor(engine, batch) -> None:
     engine.put_results(batch, inputs)
 
 
+def _staged_f32_sum(rows: np.ndarray) -> np.ndarray:
+    """Sum (size, n) fp16/bf16 rows with float32 accumulation, staging
+    through the native converters (core/src/half.cc) — the analog of the
+    reference's custom fp16-sum MPI op (reference half.cc:43-76 +
+    registration operations.cc:1534-1541), which exists precisely so
+    reductions never accumulate in the 10/7-bit wire mantissa."""
+    from horovod_tpu.core import engine as engine_mod
+
+    lib = engine_mod.lib()
+    if rows.dtype.name == "float16":
+        to_f32, from_f32 = lib.hvd_half_to_float, lib.hvd_float_to_half
+    else:
+        to_f32, from_f32 = lib.hvd_bf16_to_float, lib.hvd_float_to_bf16
+    rows = np.ascontiguousarray(rows)
+    f32 = np.empty(rows.size, np.float32)
+    to_f32(rows.ctypes.data, f32.ctypes.data, rows.size)
+    acc = np.ascontiguousarray(f32.reshape(rows.shape).sum(axis=0))
+    out = np.empty(acc.size, rows.dtype)
+    from_f32(acc.ctypes.data, out.ctypes.data, acc.size)
+    return out
+
+
 def multihost_executor(engine, batch) -> None:
     import jax.numpy as jnp
     from jax.experimental import multihost_utils
@@ -56,17 +78,24 @@ def multihost_executor(engine, batch) -> None:
         flat = np.concatenate([a.ravel() for a in inputs])
         gathered = multihost_utils.process_allgather(
             jnp.asarray(flat)[None], tiled=False)
-        summed = np.asarray(gathered.reshape(size, -1).sum(axis=0),
-                            dtype=flat.dtype)
+        rows = np.asarray(gathered).reshape(size, -1)
+        if rows.dtype.name in ("float16", "bfloat16"):
+            # Half-precision wire, float32 accumulation (half.cc staging).
+            summed = _staged_f32_sum(rows)
+        else:
+            summed = rows.sum(axis=0).astype(flat.dtype)
         outs = []
         off = 0
         for a in inputs:
             outs.append(summed[off:off + a.size].reshape(a.shape))
             off += a.size
         engine.put_results(batch, outs)
-    elif batch.type == engine_mod.OP_ALLGATHER:
+    elif batch.type in (engine_mod.OP_ALLGATHER, engine_mod.OP_ALLTOALL):
         # Ragged dim-0 gather using the negotiated per-rank sizes
         # (reference MPI_Allgatherv path, operations.cc:1273-1332).
+        # ALLTOALL payloads gather identically; the caller slices each
+        # rank's chunk out of the concat at synchronize time using the
+        # companion splits gather (ops/async_ops.py:alltoall).
         a = inputs[0]
         sizes = batch.first_dim_sizes
         max_d = max(sizes) if sizes else a.shape[0]

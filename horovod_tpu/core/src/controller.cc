@@ -250,12 +250,13 @@ void Coordinator::Ingest(const Request& req) {
         << ": rank " << req.rank << " sent " << req.shape.DebugString()
         << " but rank " << rec.first.rank << " sent "
         << rec.first.shape.DebugString() << ".";
-  } else if (req.op == OpType::ALLGATHER &&
+  } else if ((req.op == OpType::ALLGATHER || req.op == OpType::ALLTOALL) &&
              (req.shape.dims.size() != rec.first.shape.dims.size() ||
               !std::equal(req.shape.dims.begin() + (req.shape.dims.empty() ? 0 : 1),
                           req.shape.dims.end(),
                           rec.first.shape.dims.begin() + (rec.first.shape.dims.empty() ? 0 : 1)))) {
-    err << "Mismatched trailing shapes for allgather " << req.name
+    err << "Mismatched trailing shapes for " << OpTypeName(req.op) << " "
+        << req.name
         << " (only dim 0 may differ): rank " << req.rank << " sent "
         << req.shape.DebugString() << " but rank " << rec.first.rank
         << " sent " << rec.first.shape.DebugString() << ".";
@@ -280,7 +281,12 @@ Response Coordinator::Finalize(const std::string& name) {
         resp.first_dim_sizes = rec.first_dim_sizes;
         break;
       case OpType::BROADCAST: resp.type = Response::Type::BROADCAST; break;
-      case OpType::ALLTOALL: resp.type = Response::Type::ALLTOALL; break;
+      case OpType::ALLTOALL:
+        // Executors ragged-gather alltoall payloads exactly like allgather;
+        // the per-rank dim-0 sizes locate each rank's block in the concat.
+        resp.type = Response::Type::ALLTOALL;
+        resp.first_dim_sizes = rec.first_dim_sizes;
+        break;
       case OpType::BARRIER: resp.type = Response::Type::BARRIER; break;
     }
   }

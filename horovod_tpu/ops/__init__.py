@@ -2,6 +2,7 @@ from horovod_tpu.ops.collective_ops import (  # noqa: F401
     allgather,
     allreduce,
     allreduce_sparse,
+    alltoall,
     batch_spec,
     broadcast,
     grouped_allreduce,
@@ -16,6 +17,7 @@ from horovod_tpu.ops.flash_attention import (  # noqa: F401
 from horovod_tpu.ops.async_ops import (  # noqa: F401
     allgather_async,
     allreduce_async,
+    alltoall_async,
     barrier,
     broadcast_async,
     poll,

@@ -81,6 +81,47 @@ def broadcast_async(tensor, root_rank: int, name: str | None = None) -> int:
     return h
 
 
+def alltoall_async(tensor, splits=None, name: str | None = None) -> int:
+    """Start a named alltoall: scatter dim-0 blocks of ``tensor`` to every
+    process and return the blocks received from them, concatenated.
+
+    ``splits`` (optional, length ``size``): rows sent to each rank; defaults
+    to an even split.  Per-rank splits may differ — the payload rides the
+    engine's ragged-allgather path (executor) and a companion int64 splits
+    gather tells ``synchronize`` where every rank's chunk lives (the
+    modern-reference ``hvd.alltoall`` contract; the v0.15 wire enum
+    ALLTOALL existed but had no executor — here it is live end to end).
+    """
+    eng = engine_mod.get_engine()
+    arr = np.asarray(tensor)
+    if arr.ndim == 0:
+        raise ValueError("alltoall requires at least one dimension")
+    name = _auto_name("alltoall", name)
+    if splits is None:
+        if arr.shape[0] % eng.size:
+            raise ValueError(
+                f"alltoall default split needs dim 0 ({arr.shape[0]}) "
+                f"divisible by size ({eng.size}); pass explicit splits.")
+        splits_arr = np.full(eng.size, arr.shape[0] // eng.size, np.int64)
+    else:
+        splits_arr = np.asarray(splits, np.int64)
+        if splits_arr.shape != (eng.size,) or splits_arr.sum() != arr.shape[0]:
+            raise ValueError(
+                f"splits must be {eng.size} values summing to dim 0 "
+                f"({arr.shape[0]}); got {splits_arr.tolist()}")
+    h_splits = eng.enqueue(f"{name}.splits", splits_arr,
+                           engine_mod.OP_ALLGATHER)
+    h = eng.enqueue(name, arr, engine_mod.OP_ALLTOALL)
+    with _meta_lock:
+        _meta[h] = {"alltoall_splits": h_splits}
+    return h
+
+
+def alltoall(tensor, splits=None, name: str | None = None):
+    """Synchronous alltoall (see ``alltoall_async``)."""
+    return synchronize(alltoall_async(tensor, splits, name))
+
+
 def barrier(name: str | None = None) -> None:
     """Block until every process reaches the barrier.
 
@@ -119,6 +160,19 @@ def synchronize(handle: int):
         _meta.pop(handle, None)
     if out is None:
         return None
+    h_splits = meta.get("alltoall_splits")
+    if h_splits is not None:
+        # The executor delivered the full ragged concat; carve out this
+        # process's chunk from every rank's block using the gathered
+        # per-rank splits (row r = rank r's send splits).
+        sp = eng.synchronize(h_splits).reshape(eng.size, eng.size)
+        me = eng.rank
+        pieces, off = [], 0
+        for r in range(eng.size):
+            start = off + int(sp[r, :me].sum())
+            pieces.append(out[start:start + int(sp[r, me])])
+            off += int(sp[r].sum())
+        out = np.concatenate(pieces, axis=0)
     if meta.get("average"):
         out = (out / eng.size).astype(out.dtype)
     comp = meta.get("compression")

@@ -36,6 +36,7 @@ from typing import Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.experimental import multihost_utils
 from jax.sharding import NamedSharding, PartitionSpec
@@ -231,6 +232,33 @@ def allgather(tensor, name: str | None = None):
     gathered = gathered.reshape((basics.size(), max_d) + tensor.shape[1:])
     pieces = [gathered[r, : int(sizes[r])] for r in range(basics.size())]
     return jnp.concatenate(pieces, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# alltoall
+# ---------------------------------------------------------------------------
+
+def alltoall(tensor, splits=None, name: str | None = None):
+    """Scatter dim-0 blocks to every worker and concatenate the blocks
+    received (the Ulysses building block — parallel/ulysses.py does the
+    in-mesh head↔sequence exchange with the same primitive).
+
+    In-mesh: ``lax.all_to_all`` — one XLA AllToAll on ICI; even splits only
+    (static shapes).  Eager: negotiated through the native engine with
+    optional per-rank ``splits`` (ragged), ops/async_ops.py:alltoall.
+    """
+    axes = _in_mesh_axes()
+    if axes is not None:
+        if splits is not None:
+            raise ValueError(
+                "explicit splits are only supported on the eager path; "
+                "in-mesh alltoall is compiled with static (even) shapes")
+        flat_axis = axes if len(axes) > 1 else axes[0]
+        return lax.all_to_all(tensor, flat_axis, split_axis=0, concat_axis=0)
+    _require_not_traced("alltoall")
+    from horovod_tpu.ops import async_ops
+
+    return jnp.asarray(async_ops.alltoall(np.asarray(tensor), splits, name))
 
 
 # ---------------------------------------------------------------------------

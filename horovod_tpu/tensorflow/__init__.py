@@ -23,7 +23,7 @@ _bcast_counter = itertools.count()
 
 from horovod_tpu.tensorflow.compression import Compression  # noqa: E402
 from horovod_tpu.tensorflow.mpi_ops import (  # noqa: F401
-    _allreduce, allgather, broadcast, init, shutdown, size, local_size,
+    _allreduce, allgather, alltoall, broadcast, init, shutdown, size, local_size,
     rank, local_rank, mpi_threads_supported,
 )
 

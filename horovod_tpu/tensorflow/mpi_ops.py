@@ -132,6 +132,28 @@ def allgather(tensor, name=None):
     return _fn(tensor)
 
 
+def alltoall(tensor, splits=None, name=None):
+    """Scatter dim-0 blocks of ``tensor`` to every process and return the
+    blocks received, concatenated (modern-reference ``hvd.alltoall``
+    surface; the 2018 reference has no alltoall).  ``splits`` (length
+    ``size``) may be ragged — negotiation + per-rank sizing ride the
+    engine's allgather wire metadata (ops/async_ops.py:alltoall)."""
+    tensor = tf.convert_to_tensor(tensor)
+    n = name if name is not None else f"tf.HorovodAlltoall.noname.{next(_counter)}"
+    if splits is not None:
+        splits = [int(s) for s in np.asarray(splits).reshape(-1)]
+
+    def _run(t):
+        from horovod_tpu.ops import async_ops
+
+        return async_ops.alltoall(np.ascontiguousarray(t.numpy()), splits, n)
+
+    out = tf.py_function(_run, [tensor], Tout=tensor.dtype)
+    # dim 0 = sum of the chunks other ranks sent us — unknown statically.
+    out.set_shape(tf.TensorShape([None]).concatenate(tensor.shape[1:]))
+    return out
+
+
 def broadcast(tensor, root_rank, name=None):
     """Broadcast ``tensor`` from ``root_rank`` (reference mpi_ops.py:150-164).
 

@@ -41,6 +41,9 @@ from horovod_tpu.torch.mpi_ops import (  # noqa: F401
     allreduce_,
     allreduce_async,
     allreduce_async_,
+    allreduce_sparse_async,
+    alltoall,
+    synchronize_sparse,
     broadcast,
     broadcast_,
     broadcast_async,

@@ -7,8 +7,11 @@ a CUDA-staging path, tensors cross into the engine as numpy views — zero-copy
 for all natively-numpy dtypes; float16 is numpy-native, and bfloat16 moves as
 an ml_dtypes view (bit-exact), exercising the engine's bf16 wire type.
 
-Autograd: ``allreduce`` is differentiable — grad(allreduce) = allreduce
-(reference mpi_ops.py:110-121) via a torch.autograd.Function.
+Autograd: ``allreduce``, ``allgather`` and ``broadcast`` are differentiable
+via torch.autograd.Functions — grad(allreduce) = allreduce (reference
+mpi_ops.py:110-121), grad(allgather) = allreduce + this rank's dim-0 slice
+(:236-254), grad(broadcast) = allreduce delivered to the root only
+(:318-332).
 """
 
 from __future__ import annotations
@@ -111,10 +114,44 @@ class _AllreduceFunction(torch.autograd.Function):
 def allreduce(tensor: torch.Tensor, average: bool = True,
               name: str | None = None,
               compression=Compression.none) -> torch.Tensor:
-    """Synchronous, differentiable allreduce (reference mpi_ops.py:86-121)."""
+    """Synchronous, differentiable allreduce (reference mpi_ops.py:86-121).
+
+    Sparse COO tensors (e.g. ``nn.Embedding(sparse=True)`` gradients) take
+    the gather path — concatenate every rank's indices and values — the
+    torch analog of the reference's ``tf.IndexedSlices`` handling
+    (reference tensorflow/__init__.py:67-78)."""
+    if tensor.is_sparse:
+        hi, hv = allreduce_sparse_async(tensor, name)
+        return synchronize_sparse(hi, hv, tensor.shape, average)
     if tensor.requires_grad:
         return _AllreduceFunction.apply(tensor, average, name, compression)
     return synchronize(allreduce_async(tensor, average, name, compression))
+
+
+def allreduce_sparse_async(tensor: torch.Tensor,
+                           name: str | None = None) -> tuple[int, int]:
+    """Start the sparse (gather-based) allreduce of a COO tensor; returns the
+    (indices, values) handle pair.  Per-rank nnz may differ — the engine's
+    ragged allgather carries dim-0 sizes like the reference's
+    ``MPI_Allgatherv`` response."""
+    g = tensor.coalesce()
+    name = _auto_name("allreduce.sparse", name)
+    hi = allgather_async(g.indices().t().contiguous(), name=f"{name}.indices")
+    hv = allgather_async(g.values(), name=f"{name}.values")
+    return hi, hv
+
+
+def synchronize_sparse(hi: int, hv: int, shape, average: bool = True
+                       ) -> torch.Tensor:
+    """Complete an ``allreduce_sparse_async``: rebuild one COO tensor whose
+    duplicate coordinates sum across ranks (coalesce = the reduction)."""
+    indices = synchronize(hi)
+    values = synchronize(hv)
+    if average:
+        values = values / basics.size() if values.is_floating_point() \
+            else torch.div(values, basics.size(), rounding_mode="trunc")
+    return torch.sparse_coo_tensor(indices.t(), values,
+                                   tuple(shape)).coalesce()
 
 
 def allreduce_async(tensor: torch.Tensor, average: bool = True,
@@ -138,9 +175,28 @@ def allreduce_async_(tensor: torch.Tensor, average: bool = True,
 
 # -- allgather --------------------------------------------------------------
 
+class _AllgatherFunction(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, name):
+        ctx.dim0 = tensor.shape[0]
+        return synchronize(allgather_async(tensor, name))
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        # grad(allgather) = sum each rank's grad for the gathered tensor,
+        # then take this rank's dim-0 segment (reference mpi_ops.py:236-254).
+        grad = allreduce(grad_output.contiguous(), average=False)
+        sizes = allgather(torch.tensor([ctx.dim0], dtype=torch.int64))
+        offset = int(sizes[:basics.rank()].sum())
+        return grad[offset:offset + ctx.dim0], None
+
+
 def allgather(tensor: torch.Tensor, name: str | None = None) -> torch.Tensor:
     """Concatenate along dim 0 across ranks; dim-0 sizes may differ per rank
-    (reference mpi_ops.py:228-307)."""
+    (reference mpi_ops.py:228-307).  Differentiable (reference
+    HorovodAllgather, mpi_ops.py:236-254)."""
+    if tensor.requires_grad:
+        return _AllgatherFunction.apply(tensor, name)
     return synchronize(allgather_async(tensor, name))
 
 
@@ -148,11 +204,46 @@ def allgather_async(tensor: torch.Tensor, name: str | None = None) -> int:
     return _enqueue("allgather", tensor, engine_mod.OP_ALLGATHER, name)
 
 
+# -- alltoall ---------------------------------------------------------------
+
+def alltoall(tensor: torch.Tensor, splits=None,
+             name: str | None = None) -> torch.Tensor:
+    """Scatter dim-0 blocks of ``tensor`` to every rank and return the
+    blocks received, concatenated (modern-reference ``hvd.alltoall``;
+    negotiated + ragged via the engine, ops/async_ops.py:alltoall)."""
+    from horovod_tpu.ops import async_ops
+
+    if splits is not None and torch.is_tensor(splits):
+        splits = splits.tolist()
+    out = async_ops.alltoall(_to_numpy(tensor), splits,
+                             _auto_name("torch.alltoall", name))
+    return _to_torch(out, tensor)
+
+
 # -- broadcast --------------------------------------------------------------
+
+class _BroadcastFunction(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, root_rank, name):
+        ctx.root_rank = root_rank
+        return synchronize(broadcast_async(tensor, root_rank, name))
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        # grad(broadcast) = sum of downstream grads, delivered to the root
+        # only (reference HorovodBroadcast, mpi_ops.py:318-332).
+        grad = allreduce(grad_output.contiguous(), average=False)
+        if basics.rank() != ctx.root_rank:
+            grad = grad * 0
+        return grad, None, None
+
 
 def broadcast(tensor: torch.Tensor, root_rank: int,
               name: str | None = None) -> torch.Tensor:
-    """Synchronous broadcast from ``root_rank`` (reference mpi_ops.py:310-345)."""
+    """Synchronous broadcast from ``root_rank`` (reference mpi_ops.py:310-345).
+    Differentiable (reference HorovodBroadcast, mpi_ops.py:318-332)."""
+    if tensor.requires_grad:
+        return _BroadcastFunction.apply(tensor, root_rank, name)
     return synchronize(broadcast_async(tensor, root_rank, name))
 
 

@@ -20,11 +20,17 @@ from horovod_tpu.torch.compression import Compression
 def DistributedOptimizer(optimizer: torch.optim.Optimizer,
                          named_parameters=None,
                          compression=Compression.none,
-                         backward_passes_per_step: int = 1):
+                         backward_passes_per_step: int = 1,
+                         sparse_as_dense: bool = False):
     """Wrap ``optimizer`` so ``step()`` applies globally averaged gradients
-    (reference torch/__init__.py:119-150 factory)."""
+    (reference torch/__init__.py:119-150 factory).
+
+    Sparse gradients (``nn.Embedding(sparse=True)``) are routed through the
+    gather-based sparse allreduce automatically; ``sparse_as_dense=True``
+    densifies them first instead (the reference's escape hatch,
+    tensorflow/__init__.py:197-199)."""
     return _DistributedOptimizer(optimizer, named_parameters, compression,
-                                 backward_passes_per_step)
+                                 backward_passes_per_step, sparse_as_dense)
 
 
 class _DistributedOptimizer:
@@ -32,9 +38,10 @@ class _DistributedOptimizer:
     subclass, torch/__init__.py:140-147, without the metaclass gymnastics)."""
 
     def __init__(self, optimizer, named_parameters, compression,
-                 backward_passes_per_step):
+                 backward_passes_per_step, sparse_as_dense=False):
         self._opt = optimizer
         self._compression = compression
+        self._sparse_as_dense = sparse_as_dense
         self._bpps = max(backward_passes_per_step, 1)
         self._accum: dict[int, int] = {}          # id(param) → hook fires seen
         self._handles: dict[torch.nn.Parameter, tuple[int, object]] = {}
@@ -74,7 +81,18 @@ class _DistributedOptimizer:
                     f"Gradient for {name} was allreduced twice before "
                     f"step(); for gradient accumulation pass "
                     f"backward_passes_per_step.")
-            compressed, ctx = self._compression.compress(p.grad)
+            grad = p.grad
+            if grad.is_sparse:
+                if self._sparse_as_dense:
+                    with torch.no_grad():
+                        p.grad = grad.to_dense()
+                    grad = p.grad
+                else:
+                    hi, hv = mpi_ops.allreduce_sparse_async(
+                        grad, name=f"DistributedOptimizer.{name}")
+                    self._handles[p] = (("sparse", hi, hv), None)
+                    return
+            compressed, ctx = self._compression.compress(grad)
             h = mpi_ops.allreduce_async(compressed, average=True,
                                         name=f"DistributedOptimizer.{name}")
             self._handles[p] = (h, ctx)
@@ -84,6 +102,11 @@ class _DistributedOptimizer:
         """Drain outstanding allreduces into ``.grad`` (reference
         torch/__init__.py:99-108)."""
         for p, (h, ctx) in list(self._handles.items()):
+            if isinstance(h, tuple) and h[0] == "sparse":
+                _, hi, hv = h
+                p.grad = mpi_ops.synchronize_sparse(hi, hv, p.shape,
+                                                    average=True)
+                continue
             out = self._compression.decompress(mpi_ops.synchronize(h), ctx)
             with torch.no_grad():
                 p.grad.copy_(out)

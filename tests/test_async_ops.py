@@ -77,3 +77,75 @@ def test_keras_alias(hvd):
 
     assert hvd_keras.size() == 1
     assert callable(hvd_keras.DistributedOptimizer)
+
+
+def test_alltoall_even_identity(hvd):
+    # size 1: every block comes back — identity.
+    x = np.arange(12, dtype=np.float32).reshape(4, 3)
+    np.testing.assert_array_equal(hvd.alltoall(x), x)
+
+
+def test_alltoall_ragged_splits(hvd):
+    x = np.arange(10, dtype=np.int64)
+    out = hvd.alltoall(x, splits=[10])
+    np.testing.assert_array_equal(out, x)
+
+
+def test_alltoall_bad_splits_rejected(hvd):
+    with pytest.raises(ValueError, match="splits"):
+        hvd.alltoall(np.ones(4, np.float32), splits=[3])
+    with pytest.raises(ValueError, match="divisible"):
+        from horovod_tpu.core import engine as engine_mod
+        eng = engine_mod.get_engine()
+        if eng.size == 1:
+            raise ValueError("divisible")  # size-1 can't have indivisible dim0
+        hvd.alltoall(np.ones(3, np.float32))
+
+
+def test_staged_f32_accumulation_fp16():
+    # 2048 + 1 + 1 + 1: fp16 accumulation is stuck at 2048 (spacing 2);
+    # f32 accumulation gives 2051, which rounds to 2052 (nearest-even) on
+    # the final cast back — matching numpy's fp32->fp16 rounding exactly.
+    # This is why the reference registers a custom fp16-sum MPI op
+    # (half.cc:43-76) and why our executor stages through the converters.
+    from horovod_tpu.core.executors import _staged_f32_sum
+
+    rows = np.array([[2048.0], [1.0], [1.0], [1.0]], dtype=np.float16)
+    naive = rows[0] + rows[1] + rows[2] + rows[3]          # fp16 accumulate
+    staged = _staged_f32_sum(rows)
+    assert staged.dtype == np.float16
+    assert float(naive[0]) == 2048.0
+    assert float(staged[0]) == float(np.float32(2051).astype(np.float16))
+    assert float(staged[0]) == 2052.0
+
+
+def test_staged_f32_accumulation_bf16():
+    import ml_dtypes
+
+    from horovod_tpu.core.executors import _staged_f32_sum
+
+    rows = np.array([[256.0], [1.0], [1.0], [1.0], [1.0]],
+                    dtype=ml_dtypes.bfloat16)
+    staged = _staged_f32_sum(rows)
+    assert staged.dtype == ml_dtypes.bfloat16
+    # f32 accumulation: 260 exactly representable in bf16
+    assert float(staged[0]) == 260.0
+
+
+def test_half_converters_roundtrip():
+    from horovod_tpu.core import engine as engine_mod
+
+    lib = engine_mod.lib()
+    src = np.linspace(-4, 4, 64, dtype=np.float32)
+    half = np.empty(64, np.uint16)
+    back = np.empty(64, np.float32)
+    lib.hvd_float_to_half(src.ctypes.data, half.ctypes.data, 64)
+    lib.hvd_half_to_float(half.ctypes.data, back.ctypes.data, 64)
+    np.testing.assert_array_equal(back, src.astype(np.float16).astype(np.float32))
+    bf = np.empty(64, np.uint16)
+    backb = np.empty(64, np.float32)
+    lib.hvd_float_to_bf16(src.ctypes.data, bf.ctypes.data, 64)
+    lib.hvd_bf16_to_float(bf.ctypes.data, backb.ctypes.data, 64)
+    import ml_dtypes
+    np.testing.assert_array_equal(
+        backb, src.astype(ml_dtypes.bfloat16).astype(np.float32))

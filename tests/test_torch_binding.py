@@ -144,3 +144,69 @@ def test_broadcast_optimizer_state(hvdt):
 def test_broadcast_object(hvdt):
     obj = {"epoch": 3, "best": 0.91}
     assert hvd_torch.broadcast_object(obj, root_rank=0) == obj
+
+
+def test_allgather_grad(hvdt):
+    # grad(allgather) = allreduce of the gathered grad, narrowed to this
+    # rank's dim-0 segment (reference HorovodAllgather backward,
+    # mpi_ops.py:236-254).  Single process: identity on the upstream grad.
+    x = torch.arange(6, dtype=torch.float32).reshape(3, 2).requires_grad_()
+    y = hvdt.allgather(x)
+    (y * torch.arange(6.).reshape(3, 2)).sum().backward()
+    torch.testing.assert_close(x.grad, torch.arange(6.).reshape(3, 2))
+
+
+def test_broadcast_grad_root(hvdt):
+    # Rank 0 IS the root here, so the summed grad lands intact (reference
+    # HorovodBroadcast backward zeroes it off-root, mpi_ops.py:318-332).
+    x = torch.ones(4, requires_grad=True)
+    y = hvdt.broadcast(x, root_rank=0)
+    (y * 3.0).sum().backward()
+    torch.testing.assert_close(x.grad, torch.full((4,), 3.0))
+
+
+def test_allreduce_sparse_roundtrip(hvdt):
+    dense = torch.zeros(6, 3)
+    dense[1] = 2.0
+    dense[4] = -1.0
+    sp = dense.to_sparse_coo()
+    out = hvdt.allreduce(sp, average=True)
+    assert out.is_sparse
+    torch.testing.assert_close(out.to_dense(), dense)
+
+
+def test_distributed_optimizer_sparse_embedding(hvdt):
+    # nn.Embedding(sparse=True) gradients must route through the
+    # gather-based sparse path automatically (reference routes IndexedSlices
+    # the same way inside DistributedOptimizer, tensorflow/__init__.py:67-78).
+    torch.manual_seed(0)
+    emb = torch.nn.Embedding(10, 4, sparse=True)
+    opt = hvdt.DistributedOptimizer(
+        torch.optim.SGD(emb.parameters(), lr=0.5),
+        named_parameters=emb.named_parameters())
+    ids = torch.tensor([1, 3, 3, 7])
+    before = emb.weight.detach().clone()
+    loss = emb(ids).pow(2).sum()
+    loss.backward()
+    assert emb.weight.grad.is_sparse
+    opt.step()
+    # rows 1, 3, 7 moved; all others untouched
+    moved = (emb.weight.detach() - before).abs().sum(dim=1) > 0
+    assert moved[1] and moved[3] and moved[7]
+    assert not moved[0] and not moved[9]
+    # and training actually descends
+    opt.zero_grad()
+    loss2 = emb(ids).pow(2).sum()
+    assert float(loss2) < float(loss)
+
+
+def test_distributed_optimizer_sparse_as_dense(hvdt):
+    torch.manual_seed(0)
+    emb = torch.nn.Embedding(8, 4, sparse=True)
+    opt = hvdt.DistributedOptimizer(
+        torch.optim.SGD(emb.parameters(), lr=0.1),
+        named_parameters=emb.named_parameters(), sparse_as_dense=True)
+    loss = emb(torch.tensor([2, 5])).sum()
+    loss.backward()
+    opt.step()  # grad was densified before the allreduce
+    assert not emb.weight.grad.is_sparse
