@@ -103,19 +103,41 @@ class _LRCallback(Callback):
         return self._current
 
     def momentum_correction_factor(self) -> float:
-        """Multiply momentum buffers by this when the LR jumps.
+        """The reference's keras-form correction factor (new_lr / old_lr).
 
-        The reference rescales the momentum term so an LR change does not
-        distort accumulated velocity (keras/callbacks_impl.py:70-146,
-        ``restore_momentum``/``momentum_correction`` dance).  With optax,
-        apply to e.g. ``opt_state.trace``: see ``apply_momentum_correction``.
+        Only relevant for optimizers whose velocity ABSORBS the LR (keras
+        v = m·v − lr·g): multiply that velocity by this on an LR jump
+        (keras/callbacks_impl.py:70-146).  Do NOT apply it to an optax
+        ``trace`` — optax velocity is LR-free and already follows the
+        corrected trajectory (see ``_set``).
         """
         if not self.momentum_correction or self._prev == 0:
             return 1.0
         return self._current / self._prev
 
-    def _set(self, lr: float):
+    def _set(self, lr: float, state=None):
+        """Publish the new LR and keep the velocity trajectory consistent.
+
+        The reference's momentum correction (keras/callbacks_impl.py:108-117,
+        per "Accurate, Large Minibatch SGD" §2.1) exists because keras-era
+        SGD *absorbs* the LR into its velocity (v = m·v − lr·g), so an LR
+        jump distorts accumulated momentum; the correction rescales it by
+        new/old.  optax's ``trace`` is the paper's LR-FREE reference form
+        (v = m·v + g, update = −lr·v): with ``momentum_correction=True`` the
+        corrected trajectory is what optax already produces, so there is
+        nothing to rescale — the correction is auto-applied by construction
+        (asserted against a hand-rolled keras-form optimizer in
+        tests/test_callbacks.py).  ``momentum_correction=False`` reproduces
+        the reference's *uncorrected* keras trajectory by scaling the trace
+        by old/new on the jump.
+        """
         self._prev, self._current = self._current, lr
+        if (state is not None and not self.momentum_correction
+                and self._prev not in (0.0, lr)
+                and hasattr(state, "opt_state")):
+            state = state.replace(opt_state=apply_momentum_correction(
+                state.opt_state, self._prev / self._current))
+        return state
 
 
 class LearningRateScheduleCallback(_LRCallback):
@@ -147,14 +169,15 @@ class LearningRateScheduleCallback(_LRCallback):
     def on_epoch_begin(self, epoch: int, state):
         self._epoch = epoch
         if self.staircase and self._in_range(epoch):
-            self._set(self.initial_lr * self.multiplier(epoch))
+            state = self._set(self.initial_lr * self.multiplier(epoch), state)
         return state
 
     def on_batch_begin(self, batch: int, state):
         if not self.staircase and self.steps_per_epoch:
             epoch = self._epoch + batch / self.steps_per_epoch
             if self._in_range(epoch):
-                self._set(self.initial_lr * self.multiplier(epoch))
+                state = self._set(self.initial_lr * self.multiplier(epoch),
+                                  state)
         return state
 
 

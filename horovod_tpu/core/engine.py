@@ -94,6 +94,9 @@ def _load_library() -> ctypes.CDLL:
                                    ctypes.c_int, ctypes.c_double]
     lib.hvd_batch_done.argtypes = [ctypes.c_void_p, ctypes.c_longlong,
                                    ctypes.c_int, ctypes.c_char_p]
+    lib.hvd_batch_activity.restype = None
+    lib.hvd_batch_activity.argtypes = [ctypes.c_void_p, ctypes.c_longlong,
+                                       ctypes.c_char_p]
     lib.hvd_poll.restype = ctypes.c_int
     lib.hvd_poll.argtypes = [ctypes.c_void_p, ctypes.c_longlong]
     lib.hvd_wait.restype = ctypes.c_int
@@ -315,6 +318,11 @@ class NativeEngine:
             except Exception as e:  # noqa: BLE001 - report, don't kill thread
                 self._lib.hvd_batch_done(self._ptr, batch.id, STATUS_UNKNOWN,
                                          str(e).encode())
+
+    def batch_activity(self, batch: ExecBatch, activity: str) -> None:
+        """Switch the timeline phase for a batch mid-execution (reference
+        in-activity phases, operations.h:29-46); no-op without a timeline."""
+        self._lib.hvd_batch_activity(self._ptr, batch.id, activity.encode())
 
     def take_inputs(self, batch: ExecBatch) -> list[np.ndarray]:
         with self._store_lock:

@@ -37,7 +37,9 @@ def default_executor(rank: int, size: int):
 
 def local_executor(engine, batch) -> None:
     """Single-process semantics: sum/gather/broadcast over one contributor."""
+    engine.batch_activity(batch, "WAIT_FOR_DATA")
     inputs = engine.take_inputs(batch)
+    engine.batch_activity(batch, "LOCAL_COPY")
     engine.put_results(batch, inputs)
 
 
@@ -69,13 +71,16 @@ def multihost_executor(engine, batch) -> None:
 
     from horovod_tpu.core import engine as engine_mod
 
+    engine.batch_activity(batch, "WAIT_FOR_DATA")
     inputs = engine.take_inputs(batch)
     size = engine.size
 
     if batch.type == engine_mod.OP_ALLREDUCE:
         # Fused flat buffer, one collective (reference fusion semantics,
-        # operations.cc:969-1258).
+        # operations.cc:969-1258; phase names from operations.h:29-46).
+        engine.batch_activity(batch, "MEMCPY_IN_FUSION_BUFFER")
         flat = np.concatenate([a.ravel() for a in inputs])
+        engine.batch_activity(batch, "PROCESS_ALLREDUCE")
         gathered = multihost_utils.process_allgather(
             jnp.asarray(flat)[None], tiled=False)
         rows = np.asarray(gathered).reshape(size, -1)
@@ -84,6 +89,7 @@ def multihost_executor(engine, batch) -> None:
             summed = _staged_f32_sum(rows)
         else:
             summed = rows.sum(axis=0).astype(flat.dtype)
+        engine.batch_activity(batch, "MEMCPY_OUT_FUSION_BUFFER")
         outs = []
         off = 0
         for a in inputs:
@@ -96,6 +102,9 @@ def multihost_executor(engine, batch) -> None:
         # ALLTOALL payloads gather identically; the caller slices each
         # rank's chunk out of the concat at synchronize time using the
         # companion splits gather (ops/async_ops.py:alltoall).
+        engine.batch_activity(
+            batch, "PROCESS_ALLGATHER" if batch.type ==
+            engine_mod.OP_ALLGATHER else "PROCESS_ALLTOALL")
         a = inputs[0]
         sizes = batch.first_dim_sizes
         max_d = max(sizes) if sizes else a.shape[0]
@@ -107,6 +116,7 @@ def multihost_executor(engine, batch) -> None:
         pieces = [gathered[r, : sizes[r]] for r in range(size)]
         engine.put_results(batch, [np.concatenate(pieces, axis=0)])
     elif batch.type == engine_mod.OP_BROADCAST:
+        engine.batch_activity(batch, "PROCESS_BROADCAST")
         a = inputs[0]
         out = np.asarray(multihost_utils.broadcast_one_to_all(
             jnp.asarray(a), is_source=engine.rank == batch.root_rank))

@@ -114,6 +114,11 @@ int hvd_next_batch(void* e, char* buf, int buflen, double timeout_ms) {
   return static_cast<int>(w.buf.size());
 }
 
+void hvd_batch_activity(void* e, long long batch_id, const char* activity) {
+  static_cast<Engine*>(e)->BatchActivity(batch_id,
+                                         activity ? activity : "");
+}
+
 void hvd_batch_done(void* e, long long batch_id, int status,
                     const char* reason) {
   Status s;

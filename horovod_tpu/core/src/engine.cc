@@ -228,7 +228,7 @@ void Engine::DispatchResponses(const ResponseList& responses) {
     if (!batch.names.empty()) {
       if (timeline_.Initialized()) {
         for (const auto& n : batch.names) {
-          timeline_.ActivityStart(n, "QUEUE_EXEC");
+          timeline_.ActivityStart(n, "QUEUE");
         }
       }
       executing_[batch.id] = batch;
@@ -258,6 +258,17 @@ void Engine::RequeueBatch(ExecBatch batch) {
   std::lock_guard<std::mutex> l(mu_);
   exec_queue_.push_front(std::move(batch));
   exec_cv_.notify_one();
+}
+
+void Engine::BatchActivity(int64_t batch_id, const std::string& activity) {
+  std::lock_guard<std::mutex> l(mu_);
+  if (!timeline_.Initialized()) return;
+  auto it = executing_.find(batch_id);
+  if (it == executing_.end()) return;
+  for (const auto& n : it->second.names) {
+    timeline_.ActivityEnd(n);
+    timeline_.ActivityStart(n, activity);
+  }
 }
 
 void Engine::BatchDone(int64_t batch_id, const Status& status) {

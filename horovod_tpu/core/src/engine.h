@@ -80,6 +80,11 @@ class Engine {
   // serialization buffer was too small and the caller will retry bigger).
   void RequeueBatch(ExecBatch batch);
   void BatchDone(int64_t batch_id, const Status& status);
+  // Switch the timeline activity phase for every tensor in an executing
+  // batch (reference in-activity phases, operations.h:29-46 /
+  // operations.cc:698-710: QUEUE, MEMCPY_IN_FUSION_BUFFER, <collective>,
+  // MEMCPY_OUT_FUSION_BUFFER).  No-op when the timeline is disabled.
+  void BatchActivity(int64_t batch_id, const std::string& activity);
 
   // Handle table (reference torch/handle_manager.{h,cc}).
   bool PollHandle(int64_t handle);                 // true = done

@@ -188,7 +188,12 @@ def grouped_allreduce(tensors: Sequence, average: bool = True,
     else:
         _require_not_traced("grouped_allreduce")
         denom = basics.size()
-        reduced = [_eager_process_reduce(c) for c, _ in comp]
+        # Same flat-bucket fusion as the in-mesh branch: one process
+        # collective per bucket instead of one per tensor — the per-call
+        # latency the reference's fusion buffer exists to amortise
+        # (operations.cc:743-767).
+        reduced = fusion.fused_apply(
+            [c for c, _ in comp], _eager_process_reduce, threshold_bytes)
     if average:
         reduced = [r / denom for r in reduced]
     return [compression.decompress(r, ctx) for r, (_, ctx) in zip(reduced, comp)]

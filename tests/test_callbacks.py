@@ -69,3 +69,63 @@ def test_broadcast_callback(hvd):
     cb = hvd.callbacks.BroadcastGlobalVariablesCallback(0)
     out = cb.on_train_begin(state)
     np.testing.assert_array_equal(out.params["w"], state.params["w"])
+
+
+def _keras_form_sgd_trajectory(lrs, momentum, grads, w0, corrected):
+    """Hand-rolled keras-era SGD (velocity ABSORBS lr: v = m*v - lr*g) with
+    the reference's momentum correction applied on LR jumps
+    (keras/callbacks_impl.py:108-117): the jump step uses m' = m*new/old."""
+    w, v, prev_lr = w0, 0.0, lrs[0]
+    for lr, g in zip(lrs, grads):
+        m_eff = momentum * (lr / prev_lr) if (corrected and lr != prev_lr) \
+            else momentum
+        v = m_eff * v - lr * g
+        w = w + v
+        prev_lr = lr
+    return w
+
+
+@pytest.mark.parametrize("corrected", [True, False])
+def test_lr_schedule_matches_reference_momentum_semantics(hvd, corrected):
+    """The optax trajectory under our LR callback must equal the reference
+    keras trajectory: corrected when momentum_correction=True (optax's
+    lr-free trace IS the corrected form — Goyal et al. §2.1), uncorrected
+    (trace scaled by old/new on the jump) when False."""
+    m = 0.9
+    lrs = [1.0, 1.0, 0.1, 0.1]      # staircase drop at epoch 2
+    grads = [1.0, 0.5, 1.0, 0.25]
+    w_ref = _keras_form_sgd_trajectory(lrs, m, grads, 2.0, corrected)
+
+    cb = hvd.callbacks.LearningRateScheduleCallback(
+        1.0, multiplier=lambda e: 0.1 if e >= 2 else 1.0,
+        momentum_correction=corrected)
+    opt = optax.trace(decay=m)       # lr applied outside, per callback lr()
+    params = {"w": jnp.asarray(2.0)}
+    state = FakeState(params=params, opt_state=opt.init(params))
+    for epoch, g in enumerate(grads):
+        state = cb.on_epoch_begin(epoch, state)
+        updates, opt_state = opt.update({"w": jnp.asarray(g)},
+                                        state.opt_state, state.params)
+        new_w = state.params["w"] - cb.lr() * updates["w"]
+        state = state.replace(params={"w": new_w}, opt_state=opt_state)
+    np.testing.assert_allclose(float(state.params["w"]), w_ref, rtol=1e-6)
+
+
+def test_lr_jump_rescales_trace_only_when_uncorrected(hvd):
+    opt = optax.sgd(0.1, momentum=0.9)
+    params = {"w": jnp.ones((4,))}
+    opt_state = opt.init(params)
+    _, opt_state = opt.update({"w": jnp.ones((4,))}, opt_state, params)
+
+    def run(corrected):
+        cb = hvd.callbacks.LearningRateScheduleCallback(
+            1.0, multiplier=lambda e: 2.0 ** e,
+            momentum_correction=corrected)
+        st = FakeState(params=params, opt_state=opt_state)
+        st = cb.on_epoch_begin(0, st)   # lr 1.0, no jump
+        st = cb.on_epoch_begin(1, st)   # lr 2.0 — jump
+        return st.opt_state[0].trace["w"]
+
+    base = opt_state[0].trace["w"]
+    np.testing.assert_allclose(run(True), base)         # optax already correct
+    np.testing.assert_allclose(run(False), base * 0.5)  # keras-uncorrected

@@ -139,9 +139,18 @@ def test_timeline_written(tmp_path, monkeypatch):
     text = path.read_text()
     assert "NEGOTIATE_ALLREDUCE" in text
     assert "rank_0_ready" in text
+    # In-activity phases (reference operations.h:29-46 span names): the
+    # batch passes QUEUE → WAIT_FOR_DATA → LOCAL_COPY under local_executor.
+    assert "QUEUE" in text
+    assert "WAIT_FOR_DATA" in text
+    assert "LOCAL_COPY" in text
     # File is a JSON array (closed on engine destruction).
     events = json.loads(text)
     assert any(e.get("ph") == "M" for e in events)
+    # Every activity Begin has a matching End (balanced spans).
+    begins = sum(1 for e in events if e.get("ph") == "B")
+    ends = sum(1 for e in events if e.get("ph") == "E")
+    assert begins == ends, f"unbalanced spans: {begins} B vs {ends} E"
 
 
 # ---------------------------------------------------------------------------
