@@ -34,6 +34,7 @@ from horovod_tpu.basics import (  # noqa: F401
     shutdown,
     size,
 )
+from horovod_tpu.core.engine import CollectiveError  # noqa: F401
 from horovod_tpu.mesh import (  # noqa: F401
     DATA_AXIS,
     data_sharding,

@@ -22,14 +22,32 @@ import jax
 from horovod_tpu import basics, training
 
 
+def _lone_checkpointer():
+    """A PyTree checkpointer whose multihost barriers span ONLY the calling
+    process.  Orbax's default Checkpointer syncs across every JAX process on
+    save/restore; since this module rank-gates the filesystem work (only
+    ``root_rank`` calls orbax at all), the default would deadlock waiting
+    for processes that never enter orbax — the subset barrier keeps the
+    single caller self-consistent instead."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    if jax.process_count() > 1:
+        me = jax.process_index()
+        mp = ocp.options.MultiprocessingOptions(
+            primary_host=me, active_processes={me},
+            barrier_sync_key_prefix=f"hvd_lone_{me}")
+        return ocp.Checkpointer(ocp.PyTreeCheckpointHandler(),
+                                multiprocessing_options=mp)
+    return ocp.PyTreeCheckpointer()
+
+
 def save(path: str | os.PathLike, state: Any, *, force: bool = True) -> None:
     """Write ``state`` (any pytree) at ``path``; no-op off rank 0."""
     if basics.rank() != 0:
         return
-    import orbax.checkpoint as ocp
-
     path = os.path.abspath(os.fspath(path))
-    with ocp.PyTreeCheckpointer() as ckptr:
+    with _lone_checkpointer() as ckptr:
         ckptr.save(path, state, force=force)
 
 
@@ -47,7 +65,7 @@ def restore(path: str | os.PathLike, template: Any | None = None,
         import orbax.checkpoint as ocp
 
         p = os.path.abspath(os.fspath(path))
-        with ocp.PyTreeCheckpointer() as ckptr:
+        with _lone_checkpointer() as ckptr:
             if template is not None:
                 return ckptr.restore(p, ocp.args.PyTreeRestore(template))
             return ckptr.restore(p)
