@@ -197,6 +197,9 @@ class NativeEngine:
 
         self._executor = executor or executors.default_executor(rank, size)
         tl = env.timeline_path()
+        # Cached so batch_activity can skip the FFI call (which takes the
+        # engine-wide mutex) entirely on untimed runs — the common case.
+        self._timeline_enabled = bool(tl) and rank == 0
         self._ptr = self._lib.hvd_create(
             rank, size,
             cycle_time_ms if cycle_time_ms is not None else env.cycle_time_ms(),
@@ -322,6 +325,8 @@ class NativeEngine:
     def batch_activity(self, batch: ExecBatch, activity: str) -> None:
         """Switch the timeline phase for a batch mid-execution (reference
         in-activity phases, operations.h:29-46); no-op without a timeline."""
+        if not self._timeline_enabled:
+            return
         self._lib.hvd_batch_activity(self._ptr, batch.id, activity.encode())
 
     def take_inputs(self, batch: ExecBatch) -> list[np.ndarray]:

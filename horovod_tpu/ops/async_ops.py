@@ -111,7 +111,16 @@ def alltoall_async(tensor, splits=None, name: str | None = None) -> int:
                 f"({arr.shape[0]}); got {splits_arr.tolist()}")
     h_splits = eng.enqueue(f"{name}.splits", splits_arr,
                            engine_mod.OP_ALLGATHER)
-    h = eng.enqueue(name, arr, engine_mod.OP_ALLTOALL)
+    try:
+        h = eng.enqueue(name, arr, engine_mod.OP_ALLTOALL)
+    except Exception:
+        # Don't leak the companion handle (and its result) in the native
+        # engine if the payload enqueue is rejected (e.g. duplicate name).
+        try:
+            eng.synchronize(h_splits, timeout_s=30.0)
+        except Exception:
+            pass
+        raise
     with _meta_lock:
         _meta[h] = {"alltoall_splits": h_splits}
     return h
@@ -155,6 +164,15 @@ def synchronize(handle: int):
     except Exception:
         with _meta_lock:
             _meta.pop(handle, None)
+        h_splits = meta.get("alltoall_splits")
+        if h_splits is not None:
+            # Drain the companion splits gather so a failed alltoall does
+            # not leak its handle/result in the engine (the splits op is
+            # independent and completes on its own).
+            try:
+                eng.synchronize(h_splits, timeout_s=30.0)
+            except Exception:
+                pass
         raise
     with _meta_lock:
         _meta.pop(handle, None)

@@ -94,12 +94,19 @@ def test_alltoall_ragged_splits(hvd):
 def test_alltoall_bad_splits_rejected(hvd):
     with pytest.raises(ValueError, match="splits"):
         hvd.alltoall(np.ones(4, np.float32), splits=[3])
+
+
+def test_alltoall_indivisible_rejected(hvd, monkeypatch):
+    # Validation runs before any enqueue, so faking size=2 on the live
+    # engine is safe: nothing is ever negotiated.
+    from horovod_tpu.core import engine as engine_mod
+
+    eng = engine_mod.get_engine()
+    monkeypatch.setattr(eng, "size", 2)
     with pytest.raises(ValueError, match="divisible"):
-        from horovod_tpu.core import engine as engine_mod
-        eng = engine_mod.get_engine()
-        if eng.size == 1:
-            raise ValueError("divisible")  # size-1 can't have indivisible dim0
-        hvd.alltoall(np.ones(3, np.float32))
+        hvd.alltoall_async(np.ones(3, np.float32))
+    with pytest.raises(ValueError, match="splits"):
+        hvd.alltoall_async(np.ones(4, np.float32), splits=[4])  # wrong len
 
 
 def test_staged_f32_accumulation_fp16():
