@@ -80,7 +80,15 @@ def _flash_kernel(meta_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc0 = jnp.zeros((block_q, d), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, num_k_blocks, body, (m0, l0, acc0))
+    if causal:
+        # Skip K blocks entirely above the diagonal: the last contributing
+        # block is the one containing this q-block's max position.  Halves
+        # the streamed blocks for causal attention (dynamic fori bound).
+        q_max = meta_ref[0] + (qi + 1) * block_q - 1
+        hi = jnp.clip((q_max - meta_ref[1]) // block_k + 1, 0, num_k_blocks)
+    else:
+        hi = num_k_blocks
+    m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
     # log-sum-exp per query row (NEG_INF where a row attended to nothing) —
     # lets callers combine partial attentions exactly (ring attention).
@@ -182,8 +190,15 @@ def _bwd_dq_kernel(meta_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
+    if causal:
+        # Same diagonal bound as the forward: K blocks past this q-block's
+        # max position contribute p == 0 — skip them.
+        q_max = meta_ref[0] + (qi + 1) * block_q - 1
+        hi = jnp.clip((q_max - meta_ref[1]) // block_k + 1, 0, num_k_blocks)
+    else:
+        hi = num_k_blocks
     dq = jax.lax.fori_loop(
-        0, num_k_blocks, body, jnp.zeros((block_q, q.shape[-1]), jnp.float32))
+        0, hi, body, jnp.zeros((block_q, q.shape[-1]), jnp.float32))
     dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
 
@@ -230,7 +245,14 @@ def _bwd_dkv_kernel(meta_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     dk0 = jnp.zeros((block_k, d), jnp.float32)
     dv0 = jnp.zeros((block_k, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(0, num_q_blocks, body, (dk0, dv0))
+    if causal:
+        # Mirror bound: q blocks entirely BELOW this k-block's min position
+        # see only masked entries — start at the diagonal instead.
+        k_min = meta_ref[1] + ki * block_k
+        lo = jnp.clip((k_min - meta_ref[0]) // block_q, 0, num_q_blocks)
+    else:
+        lo = 0
+    dk, dv = jax.lax.fori_loop(lo, num_q_blocks, body, (dk0, dv0))
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
