@@ -43,7 +43,7 @@ def main() -> None:
     # Steps executed inside ONE compiled program via lax.scan — the
     # idiomatic TPU training loop (device loop, host out of the way).  On
     # tunneled/remote backends each dispatch costs ms; amortizing it is
-    # measured at +18% throughput (docs/benchmarks.md round-2 notes).
+    # measured at +21% throughput (docs/benchmarks.md round-2 notes).
     steps_per_call = max(1, int(os.environ.get("BENCH_STEPS_PER_CALL", "8")))
 
     n_chips = hvd.num_chips()
@@ -59,9 +59,8 @@ def main() -> None:
     opt_state = opt.init(params)
 
 
-    def train_step(carry, xy):
+    def train_step(carry, x, y):
         params, batch_stats, opt_state = carry
-        x, y = xy
 
         def loss_fn(p):
             logits, mutated = model.apply(
@@ -76,27 +75,26 @@ def main() -> None:
         return (optax.apply_updates(params, updates), new_stats,
                 opt_state), loss
 
-    def k_steps(params, batch_stats, opt_state, xs, ys):
+    def k_steps(params, batch_stats, opt_state, x, y):
+        # The synthetic protocol reuses the same batch every step
+        # (reference pytorch_synthetic_benchmark.py:61-66 likewise feeds
+        # one tensor), so x/y ride as scan-invariant shard-local args — no
+        # steps_per_call-times replicated input buffer.
         (params, batch_stats, opt_state), losses = jax.lax.scan(
-            train_step, (params, batch_stats, opt_state), (xs, ys))
+            lambda c, _: train_step(c, x, y),
+            (params, batch_stats, opt_state), None, length=steps_per_call)
         return params, batch_stats, opt_state, losses[-1]
 
     step = jax.jit(hvd.shard(
         k_steps,
-        in_specs=(P(), P(), P(), hvd.batch_spec(5, batch_dim=1),
-                  hvd.batch_spec(2, batch_dim=1)),
+        in_specs=(P(), P(), P(), hvd.batch_spec(4), hvd.batch_spec(1)),
         out_specs=(P(), P(), P(), P())),
         donate_argnums=(0, 1, 2))
-
-    # Synthetic protocol reuses the same batch every step (reference
-    # pytorch_synthetic_benchmark.py:61-66 likewise feeds one tensor).
-    xs = jnp.broadcast_to(x[None], (steps_per_call,) + x.shape)
-    ys = jnp.broadcast_to(y[None], (steps_per_call,) + y.shape)
 
     def run_one():
         nonlocal params, batch_stats, opt_state
         params, batch_stats, opt_state, loss = step(
-            params, batch_stats, opt_state, xs, ys)
+            params, batch_stats, opt_state, x, y)
         return loss
 
     loss = None

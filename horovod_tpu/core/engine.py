@@ -199,6 +199,7 @@ class NativeEngine:
         tl = env.timeline_path()
         # Cached so batch_activity can skip the FFI call (which takes the
         # engine-wide mutex) entirely on untimed runs — the common case.
+        # Single source of truth: hvd_create's timeline arg derives from it.
         self._timeline_enabled = bool(tl) and rank == 0
         self._ptr = self._lib.hvd_create(
             rank, size,
@@ -206,7 +207,7 @@ class NativeEngine:
             env.fusion_threshold_bytes(),
             env.stall_warning_seconds(),
             0 if env.stall_check_disabled() else 1,
-            tl.encode() if tl and rank == 0 else None,
+            tl.encode() if self._timeline_enabled else None,
             (coordinator_host or "127.0.0.1").encode(),
             coordinator_port)
         err = ctypes.create_string_buffer(512)

@@ -35,6 +35,15 @@ def _auto_name(prefix: str, name: str | None) -> str:
     return f"{prefix}.noname.{next(_counter)}"
 
 
+def _drain_splits(eng, h_splits: int) -> None:
+    """Best-effort completion of alltoall's companion splits gather so a
+    failed payload never leaks the companion handle/result in the engine."""
+    try:
+        eng.synchronize(h_splits, timeout_s=30.0)
+    except Exception:
+        pass
+
+
 def allreduce_async(tensor, average: bool = True, name: str | None = None,
                     compression=Compression.none) -> int:
     """Start a named allreduce; returns a handle (reference
@@ -114,12 +123,8 @@ def alltoall_async(tensor, splits=None, name: str | None = None) -> int:
     try:
         h = eng.enqueue(name, arr, engine_mod.OP_ALLTOALL)
     except Exception:
-        # Don't leak the companion handle (and its result) in the native
-        # engine if the payload enqueue is rejected (e.g. duplicate name).
-        try:
-            eng.synchronize(h_splits, timeout_s=30.0)
-        except Exception:
-            pass
+        # Payload enqueue rejected (e.g. duplicate name) — clean up.
+        _drain_splits(eng, h_splits)
         raise
     with _meta_lock:
         _meta[h] = {"alltoall_splits": h_splits}
@@ -166,13 +171,7 @@ def synchronize(handle: int):
             _meta.pop(handle, None)
         h_splits = meta.get("alltoall_splits")
         if h_splits is not None:
-            # Drain the companion splits gather so a failed alltoall does
-            # not leak its handle/result in the engine (the splits op is
-            # independent and completes on its own).
-            try:
-                eng.synchronize(h_splits, timeout_s=30.0)
-            except Exception:
-                pass
+            _drain_splits(eng, h_splits)
         raise
     with _meta_lock:
         _meta.pop(handle, None)
