@@ -24,3 +24,13 @@ from horovod_tpu.parallel.tensor_parallel import (  # noqa: F401
     ParallelMLP,
     RowParallelDense,
 )
+from horovod_tpu.parallel.pipeline import (  # noqa: F401
+    pipeline_apply,
+    stage_init_rng,
+)
+from horovod_tpu.parallel.expert import (  # noqa: F401
+    expert_init_rng,
+    expert_parallel_moe,
+    switch_route,
+)
+from horovod_tpu.parallel.zero import zero_optimizer  # noqa: F401

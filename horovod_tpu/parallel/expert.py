@@ -1,0 +1,84 @@
+"""Expert parallelism — switch-routed MoE over an ``ep`` mesh axis.
+
+Beyond reference scope (SURVEY §2.9: EP listed as absent upstream), built on
+the framework's alltoall primitive: expert parallelism IS the alltoall
+workload (dispatch tokens to the device holding their expert, compute,
+return) — the same exchange the reference era did with MPI_Alltoall-style
+collectives in later systems.
+
+TPU-first shape: ONE shard_map program over ``ep``; each device holds one
+expert's parameters; routing builds a dense [tokens, experts, capacity]
+dispatch tensor (the mesh-tensorflow/Switch-Transformer formulation — all
+static shapes, no sorts or ragged scatters, so the whole layer is two
+``lax.all_to_all`` HLOs around the expert matmuls, all MXU-friendly
+einsums).  Differentiable end to end: all_to_all transposes to the reverse
+exchange, so the backward pass runs the mirror-image token return
+automatically.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+EP_AXIS = "ep"
+
+
+def expert_init_rng(rng, axis_name: str = EP_AXIS):
+    """Fold the expert index into an RNG so each device initializes a
+    DISTINCT expert inside shard_map (same trick as
+    pipeline.stage_init_rng / tensor_parallel._per_shard)."""
+    return jax.random.fold_in(rng, lax.axis_index(axis_name))
+
+
+def switch_route(x, router_w, n_experts: int, capacity: int):
+    """Top-1 routing plan: returns (combine [T,E,C], gate [T]).
+
+    ``combine[t, e, c] = 1`` iff token t is slot c of expert e's bucket and
+    within capacity; tokens past capacity are dropped (standard switch
+    behavior — the caller's residual connection carries them unchanged).
+    """
+    logits = x @ router_w                                   # [T, E]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)                 # [T]
+    gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=-1)[:, 0]
+    onehot = jax.nn.one_hot(expert_idx, n_experts, dtype=jnp.float32)
+    # Slot of each token within its expert's bucket (0-based, in order).
+    pos = jnp.cumsum(onehot, axis=0) * onehot - onehot      # [T, E]
+    within = (pos < capacity).astype(jnp.float32) * onehot
+    combine = within[:, :, None] * jax.nn.one_hot(
+        jnp.clip(pos, 0, capacity - 1).astype(jnp.int32), capacity,
+        dtype=jnp.float32)                                  # [T, E, C]
+    return combine, gate.astype(jnp.float32)
+
+
+def expert_parallel_moe(expert_fn: Callable, expert_params, router_w, x,
+                        capacity_factor: float = 1.0,
+                        axis_name: str = EP_AXIS):
+    """Switch-MoE layer: route, alltoall-dispatch, expert compute, return.
+
+    Call inside shard_map with ``axis_name`` bound (size = number of
+    experts, one per device).  ``expert_params`` are THIS device's expert;
+    ``expert_fn(params, h)`` maps [N, D] → [N, D].  ``x``: [T, D] local
+    tokens; ``router_w``: [D, E] (replicated).  Returns [T, D]: gate-scaled
+    expert outputs; dropped tokens get zeros (add your residual).
+    """
+    n_experts = lax.axis_size(axis_name)
+    t, d = x.shape
+    capacity = max(1, int(t * capacity_factor / n_experts))
+    combine, gate = switch_route(x, router_w, n_experts, capacity)
+
+    xf = x.astype(jnp.float32)
+    dispatch = jnp.einsum("tec,td->ecd", combine, xf)       # [E, C, D]
+    # Exchange: slice e goes to device e; received dim 0 = source device.
+    recv = lax.all_to_all(dispatch, axis_name, split_axis=0, concat_axis=0)
+    h = expert_fn(expert_params,
+                  recv.reshape(n_experts * capacity, d).astype(x.dtype))
+    h = h.astype(jnp.float32).reshape(n_experts, capacity, d)
+    # Return each source device its tokens' outputs (mirror exchange).
+    back = lax.all_to_all(h, axis_name, split_axis=0, concat_axis=0)
+    out = jnp.einsum("tec,ecd->td", combine, back)          # [T, D]
+    return (out * gate[:, None]).astype(x.dtype)

@@ -1,0 +1,108 @@
+"""Pipeline parallelism — SPMD GPipe over a ``pp`` mesh axis.
+
+Beyond reference scope (SURVEY §2.9: the reference is DP-only; PP listed as
+absent), built because the task brief makes distributed-at-scale first-class
+and the mesh design must carry it.  This is the TPU-idiomatic formulation:
+instead of per-stage processes with send/recv (the GPU framework shape), the
+pipeline is ONE shard_map program over a ``pp`` axis —
+
+* every device holds one stage's parameters (per-stage RNG folding, same
+  trick as tensor_parallel.py);
+* the schedule is a ``lax.scan`` over ``M + P - 1`` ticks: each tick every
+  stage applies its layer to the microbatch it currently holds, then the
+  activations rotate one hop with ``lax.ppermute`` (stage i → i+1);
+* stage 0 injects a fresh microbatch each of the first M ticks; the last
+  stage collects an output each of the last M ticks;
+* the backward pass needs NO hand-written schedule: JAX differentiates the
+  scan-of-ppermute program, and the transposed ``ppermute`` runs the reverse
+  (1F1B-like) communication automatically.
+
+Bubble fraction is the classic (P-1)/(M+P-1) — pick ``num_microbatches``
+≥ 4·P to amortize.  All shapes are static; the whole schedule compiles to a
+single XLA while-loop with one collective-permute per tick riding ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+PP_AXIS = "pp"
+
+
+def stage_init_rng(rng, axis_name: str = PP_AXIS):
+    """Fold the stage index into an RNG so each pipeline stage initializes
+    DISTINCT parameters inside shard_map (without this every stage would
+    hold identical layer weights)."""
+    return jax.random.fold_in(rng, lax.axis_index(axis_name))
+
+
+def pipeline_apply(stage_fn: Callable, params, x,
+                   num_microbatches: int | None = None,
+                   axis_name: str = PP_AXIS):
+    """Run ``stage_fn(params, mb)`` as a GPipe pipeline over ``axis_name``.
+
+    Call inside shard_map with ``axis_name`` bound.  ``params`` are THIS
+    device's stage parameters; ``stage_fn`` must preserve the microbatch
+    shape (the standard homogeneous-stage contract — e.g. a group of
+    transformer blocks).  ``x``: [B, ...] global microbatch source, present
+    on every device (replicated in-spec); only stage 0's copy is consumed.
+    Returns [B, ...] outputs, replicated to every device.
+
+    Differentiable end to end: grad flows through the scanned ppermutes in
+    reverse, which IS the backward pipeline schedule.  Because the returned
+    outputs are replicated over ``axis_name`` (masked psum), a loss computed
+    from them on every device must be ``lax.pmean``-ed over the pipeline
+    axis — the standard replicated-compute convention — or the psum
+    transpose sums P identical cotangents and every gradient comes out P×.
+    """
+    n_stages = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    m = num_microbatches or n_stages
+    b = x.shape[0]
+    if b % m:
+        raise ValueError(f"batch {b} not divisible by num_microbatches {m}")
+    mb = b // m
+    mbs = x.reshape((m, mb) + x.shape[1:])
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    state0 = jnp.zeros((mb,) + x.shape[1:], x.dtype)
+    outputs0 = jnp.zeros((m, mb) + x.shape[1:], x.dtype)
+
+    def tick(carry, t):
+        state, outputs = carry
+        # Stage 0 swallows microbatch t (zeros once the source runs dry).
+        inject = jnp.where(t < m,
+                           lax.dynamic_index_in_dim(
+                               mbs, jnp.clip(t, 0, m - 1), keepdims=False),
+                           jnp.zeros_like(state))
+        state = jnp.where(stage == 0, inject, state)
+        state = stage_fn(params, state)
+        # The last stage banks a finished microbatch on ticks >= P-1.
+        out_idx = jnp.clip(t - (n_stages - 1), 0, m - 1)
+        banked = lax.dynamic_update_index_in_dim(
+            outputs, state.astype(outputs.dtype), out_idx, axis=0)
+        take = jnp.logical_and(stage == n_stages - 1, t >= n_stages - 1)
+        outputs = jnp.where(take, banked, outputs)
+        # Rotate activations one hop downstream.
+        state = lax.ppermute(state, axis_name, perm)
+        return (state, outputs), None
+
+    (_, outputs), _ = lax.scan(tick, (state0, outputs0),
+                               jnp.arange(m + n_stages - 1))
+    # Outputs live on the last stage only; replicate them (masked psum).
+    outputs = lax.psum(
+        jnp.where(stage == n_stages - 1, outputs, jnp.zeros_like(outputs)),
+        axis_name)
+    # Every stage now holds identical outputs and will run the SAME loss on
+    # them; under shard_map(check_vma=False) each psum transposes to a psum,
+    # so those P identical cotangents would arrive P-fold at the last stage.
+    # Scale the gradient path by 1/P (value unchanged) so replicated
+    # consumption — with or without a trailing pmean — differentiates
+    # exactly (verified against the sequential model in tests).
+    outputs = (outputs / n_stages
+               + lax.stop_gradient(outputs * (n_stages - 1) / n_stages))
+    return outputs.reshape((b,) + x.shape[1:])

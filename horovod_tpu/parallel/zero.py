@@ -1,0 +1,111 @@
+"""ZeRO-1 — optimizer state sharded over the data axis.
+
+Beyond reference scope (SURVEY §2.9: the reference replicates optimizer
+state on every rank and only broadcasts it at init), provided because
+optimizer-state memory is the first wall data-parallel training hits at
+scale.  TPU-first shape: the whole parameter tree is flattened into one
+vector (the same flat-buffer idea as the fusion buffer), each device owns a
+1/K contiguous shard of it plus the optimizer state for that shard, and a
+step is
+
+    reduce_scatter(grads)  →  local optax update on the shard
+                           →  all_gather(updates)
+
+— one reduce-scatter + one all-gather per step riding ICI, which together
+move the same bytes as the plain all-reduce they replace (that is the ZeRO-1
+observation), while optimizer state shrinks K-fold per device.
+
+Scope: the wrapped transform must be ELEMENTWISE (sgd/momentum/adam/adamw…):
+it sees only the local shard, so anything needing a global reduction over
+parameters (e.g. ``clip_by_global_norm``) would silently clip per-shard —
+compose such transforms outside, or don't shard them.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+
+from horovod_tpu import mesh as mesh_mod
+
+
+def _flatten(tree):
+    leaves, treedef = jax.tree.flatten(tree)
+    shapes = [l.shape for l in leaves]
+    sizes = [l.size for l in leaves]
+    flat = jnp.concatenate([l.reshape(-1) for l in leaves]) if leaves \
+        else jnp.zeros((0,))
+    return flat, (treedef, shapes, sizes)
+
+def _unflatten(flat, spec):
+    treedef, shapes, sizes = spec
+    out, off = [], 0
+    for shape, size in zip(shapes, sizes):
+        out.append(flat[off:off + size].reshape(shape))
+        off += size
+    return jax.tree.unflatten(treedef, out)
+
+
+def _pad_to(flat, k):
+    pad = (-flat.size) % k
+    return jnp.pad(flat, (0, pad)) if pad else flat
+
+
+def zero_optimizer(tx: optax.GradientTransformation,
+                   axis_name: str | tuple[str, ...] | None = None,
+                   average: bool = True) -> optax.GradientTransformation:
+    """Wrap an elementwise optax transform with ZeRO-1 state sharding.
+
+    In-mesh ONLY: both ``init`` and ``update`` must run inside
+    shard_map/``hvd.shard`` with ``axis_name`` bound (defaults to the global
+    mesh's data axes).  Gradients come in UN-reduced (do NOT combine with
+    ``DistributedOptimizer`` — the reduce-scatter here is the gradient
+    averaging); returned updates are full (all-gathered), so
+    ``optax.apply_updates`` works unchanged.
+    """
+
+    def axes():
+        a = axis_name if axis_name is not None else mesh_mod.data_axes()
+        return a if isinstance(a, tuple) else (a,)
+
+    def flat_axis():
+        a = axes()
+        return a if len(a) > 1 else a[0]
+
+    def width():
+        k = 1
+        for a in axes():
+            k *= lax.axis_size(a)
+        return k
+
+    def my_shard(flat):
+        k = width()
+        padded = _pad_to(flat, k)
+        chunk = padded.size // k
+        idx = lax.axis_index(flat_axis())
+        return lax.dynamic_slice_in_dim(padded, idx * chunk, chunk)
+
+    def init(params):
+        flat, _ = _flatten(params)
+        return tx.init(my_shard(flat))
+
+    def update(grads, state, params=None):
+        k = width()
+        flat_g, spec = _flatten(grads)
+        n = flat_g.size
+        # reduce-scatter: each device receives the SUM of its shard.
+        g_shard = lax.psum_scatter(_pad_to(flat_g, k), flat_axis(),
+                                   scatter_dimension=0, tiled=True)
+        if average:
+            g_shard = g_shard / k
+        p_shard = None
+        if params is not None:
+            flat_p, _ = _flatten(params)
+            p_shard = my_shard(flat_p)
+        u_shard, state = tx.update(g_shard, state, p_shard)
+        flat_u = lax.all_gather(u_shard, flat_axis(), tiled=True)[:n]
+        return _unflatten(flat_u, spec), state
+
+    return optax.GradientTransformation(init, update)
