@@ -1,0 +1,15 @@
+"""Shared helpers for the parallelism strategies."""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+
+def shard_init_rng(rng, axis_name: str):
+    """Fold this device's index on ``axis_name`` into an RNG so each shard
+    initializes DISTINCT parameters inside shard_map — without this every
+    shard would see the same key and hold identical weights (collapsing a
+    tensor-parallel layer's effective width, making every pipeline stage
+    the same layer, or every expert the same expert)."""
+    return jax.random.fold_in(rng, lax.axis_index(axis_name))

@@ -24,14 +24,14 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from horovod_tpu.parallel.common import shard_init_rng
+
 EP_AXIS = "ep"
 
 
 def expert_init_rng(rng, axis_name: str = EP_AXIS):
-    """Fold the expert index into an RNG so each device initializes a
-    DISTINCT expert inside shard_map (same trick as
-    pipeline.stage_init_rng / tensor_parallel._per_shard)."""
-    return jax.random.fold_in(rng, lax.axis_index(axis_name))
+    """Per-expert distinct RNG inside shard_map (see common.shard_init_rng)."""
+    return shard_init_rng(rng, axis_name)
 
 
 def switch_route(x, router_w, n_experts: int, capacity: int):

@@ -24,20 +24,38 @@ single XLA while-loop with one collective-permute per tick riding ICI.
 
 from __future__ import annotations
 
+import functools
 from typing import Callable
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
+from horovod_tpu.parallel.common import shard_init_rng
+
 PP_AXIS = "pp"
 
 
 def stage_init_rng(rng, axis_name: str = PP_AXIS):
-    """Fold the stage index into an RNG so each pipeline stage initializes
-    DISTINCT parameters inside shard_map (without this every stage would
-    hold identical layer weights)."""
-    return jax.random.fold_in(rng, lax.axis_index(axis_name))
+    """Per-stage distinct RNG inside shard_map (see common.shard_init_rng)."""
+    return shard_init_rng(rng, axis_name)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _grad_scale(x, s: float):
+    """Exact identity forward; cotangent scaled by ``s`` backward."""
+    return x
+
+
+def _grad_scale_fwd(x, s):
+    return x, None
+
+
+def _grad_scale_bwd(s, _, g):
+    return (jax.tree.map(lambda t: t * s, g),)
+
+
+_grad_scale.defvjp(_grad_scale_fwd, _grad_scale_bwd)
 
 
 def pipeline_apply(stage_fn: Callable, params, x,
@@ -61,7 +79,9 @@ def pipeline_apply(stage_fn: Callable, params, x,
     """
     n_stages = lax.axis_size(axis_name)
     stage = lax.axis_index(axis_name)
-    m = num_microbatches or n_stages
+    m = n_stages if num_microbatches is None else num_microbatches
+    if m < 1:
+        raise ValueError(f"num_microbatches must be >= 1, got {m}")
     b = x.shape[0]
     if b % m:
         raise ValueError(f"batch {b} not divisible by num_microbatches {m}")
@@ -100,9 +120,10 @@ def pipeline_apply(stage_fn: Callable, params, x,
     # Every stage now holds identical outputs and will run the SAME loss on
     # them; under shard_map(check_vma=False) each psum transposes to a psum,
     # so those P identical cotangents would arrive P-fold at the last stage.
-    # Scale the gradient path by 1/P (value unchanged) so replicated
-    # consumption — with or without a trailing pmean — differentiates
-    # exactly (verified against the sequential model in tests).
-    outputs = (outputs / n_stages
-               + lax.stop_gradient(outputs * (n_stages - 1) / n_stages))
+    # Scale ONLY the cotangent by 1/P (custom_vjp identity — forward values
+    # are bit-exact) so replicated consumption — with or without a trailing
+    # pmean — differentiates exactly (verified against the sequential model
+    # in tests).  A consumer that breaks the replication contract (loss on
+    # one stage only, then psum) would see 1/P-scaled gradients.
+    outputs = _grad_scale(outputs, 1.0 / n_stages)
     return outputs.reshape((b,) + x.shape[1:])

@@ -31,13 +31,12 @@ def _tp_size(axis_name: str) -> int:
 
 
 def _per_shard(base_init, axis_name: str):
-    """Fold the shard index into the param RNG so each tp rank initializes a
-    DISTINCT shard (inside shard_map every rank otherwise sees the same key
-    and the shards would be identical copies — collapsing the effective
-    width to features/K)."""
+    """Wrap an initializer with per-shard RNG folding (common.shard_init_rng)
+    so each tp rank initializes a DISTINCT weight shard."""
+    from horovod_tpu.parallel.common import shard_init_rng
+
     def init(rng, shape, *args):
-        rng = jax.random.fold_in(rng, lax.axis_index(axis_name))
-        return base_init(rng, shape, *args)
+        return base_init(shard_init_rng(rng, axis_name), shape, *args)
     return init
 
 

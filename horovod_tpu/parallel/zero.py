@@ -32,18 +32,23 @@ from horovod_tpu import mesh as mesh_mod
 
 
 def _flatten(tree):
+    """One flat vector from a pytree.  Mixed-dtype trees promote on the
+    wire (jnp.concatenate rules) — pure-bf16 or pure-f32 trees move at
+    their native width; _unflatten casts every leaf back to its own dtype
+    so callers never see the promotion."""
     leaves, treedef = jax.tree.flatten(tree)
     shapes = [l.shape for l in leaves]
     sizes = [l.size for l in leaves]
+    dtypes = [l.dtype for l in leaves]
     flat = jnp.concatenate([l.reshape(-1) for l in leaves]) if leaves \
         else jnp.zeros((0,))
-    return flat, (treedef, shapes, sizes)
+    return flat, (treedef, shapes, sizes, dtypes)
 
 def _unflatten(flat, spec):
-    treedef, shapes, sizes = spec
+    treedef, shapes, sizes, dtypes = spec
     out, off = [], 0
-    for shape, size in zip(shapes, sizes):
-        out.append(flat[off:off + size].reshape(shape))
+    for shape, size, dtype in zip(shapes, sizes, dtypes):
+        out.append(flat[off:off + size].reshape(shape).astype(dtype))
         off += size
     return jax.tree.unflatten(treedef, out)
 

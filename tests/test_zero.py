@@ -106,3 +106,20 @@ def test_zero_momentum_semantics(hvd):
         p = optax.apply_updates(p, u)
     np.testing.assert_allclose(np.asarray(out["w"]), np.asarray(p["w"]),
                                rtol=1e-6)
+
+
+def test_zero_mixed_dtypes_round_trip(hvd):
+    """Mixed bf16/f32 trees must come back in their own dtypes (the wire
+    promotes, _unflatten casts back)."""
+    params = {"w": jnp.ones((6,), jnp.bfloat16), "b": jnp.ones((4,))}
+    ztx = zero_optimizer(optax.sgd(0.1))
+
+    def step(params):
+        grads = jax.tree.map(jnp.ones_like, params)
+        state = ztx.init(params)
+        updates, _ = ztx.update(grads, state, params)
+        return updates
+
+    updates = jax.jit(hvd.shard(step, in_specs=P(), out_specs=P()))(params)
+    assert updates["w"].dtype == jnp.bfloat16
+    assert updates["b"].dtype == jnp.float32
