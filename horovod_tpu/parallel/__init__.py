@@ -2,10 +2,17 @@
 
 The reference's only strategy is DP plus a 2-level hierarchical allreduce
 (SURVEY §2.9); this package carries the hierarchical scheme over
-(hierarchy.py) and adds the long-context strategies the task brief makes
-first-class: ring attention (ring_attention.py) and Ulysses-style all-to-all
-sequence parallelism (ulysses.py), both pure shard_map/ppermute/all_to_all
-programs over the global mesh.
+(hierarchy.py) and adds the rest of the modern parallelism matrix as pure
+shard_map/collective programs over the global mesh:
+
+* sequence/context parallelism — ring attention (ring_attention.py, with a
+  fused-flash per-step kernel) and Ulysses all-to-all (ulysses.py);
+* tensor parallelism — Megatron column/row layers (tensor_parallel.py);
+* pipeline parallelism — SPMD GPipe, scan-of-ppermute (pipeline.py);
+* expert parallelism — switch-MoE over alltoall (expert.py);
+* optimizer-state sharding — ZeRO-1 reduce-scatter/all-gather (zero.py).
+
+See docs/parallelism.md for the usage guide.
 """
 
 from horovod_tpu.parallel.hierarchy import hierarchical_allreduce  # noqa: F401

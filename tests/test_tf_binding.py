@@ -255,3 +255,13 @@ def test_allgather_scalar_grad(hvdtf):
     g = tape.gradient(loss, v)
     assert g.shape == ()
     assert float(g) == 1.0
+
+
+def test_alltoall_identity(hvdtf):
+    import tensorflow as tf
+
+    x = tf.reshape(tf.range(12, dtype=tf.float32), (4, 3))
+    out = hvdtf.alltoall(x)
+    np.testing.assert_array_equal(out.numpy(), x.numpy())
+    out = hvdtf.alltoall(x, splits=[4])
+    np.testing.assert_array_equal(out.numpy(), x.numpy())

@@ -210,3 +210,9 @@ def test_distributed_optimizer_sparse_as_dense(hvdt):
     loss.backward()
     opt.step()  # grad was densified before the allreduce
     assert not emb.weight.grad.is_sparse
+
+
+def test_alltoall_identity(hvdt):
+    x = torch.arange(12.).reshape(4, 3)
+    torch.testing.assert_close(hvdt.alltoall(x), x)
+    torch.testing.assert_close(hvdt.alltoall(x, splits=torch.tensor([4])), x)
