@@ -73,6 +73,7 @@ from horovod_tpu.training import (  # noqa: F401
 from horovod_tpu import callbacks  # noqa: F401
 from horovod_tpu import checkpoint  # noqa: F401
 from horovod_tpu import data  # noqa: F401
+from horovod_tpu import parallel  # noqa: F401
 from horovod_tpu.utils import profiling  # noqa: F401
 
 __version__ = "0.1.0"

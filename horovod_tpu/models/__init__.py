@@ -15,6 +15,7 @@ from horovod_tpu.models.resnet import (  # noqa: F401
     ResNet152,
 )
 from horovod_tpu.models.mnist import MnistCNN, MnistMLP  # noqa: F401
+from horovod_tpu.models.moe import MoEMLP  # noqa: F401
 from horovod_tpu.models.transformer import (  # noqa: F401
     Transformer,
     TransformerConfig,

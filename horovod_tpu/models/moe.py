@@ -1,0 +1,71 @@
+"""MoE transformer blocks — the model-level surface of expert parallelism.
+
+Beyond reference scope (the reference has no attention or MoE code; SURVEY
+§2.9 lists EP as absent).  ``MoEMLP`` is a drop-in for the Transformer's
+dense GLU MLP: a router picks one expert per token (switch routing), tokens
+travel to the device holding their expert over ``lax.all_to_all``
+(parallel/expert.py), and the residual connection carries dropped
+(over-capacity) tokens unchanged.
+
+Must run inside shard_map with the ``ep`` axis bound; each device holds ONE
+expert's weights (distinct via per-shard RNG folding — the same contract as
+tensor_parallel / pipeline stages).  Total parameter count is
+``n_experts ×`` the dense MLP while per-token FLOPs stay constant — the MoE
+scaling trade.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.parallel.common import shard_init_rng
+from horovod_tpu.parallel.expert import expert_parallel_moe
+
+
+class MoEMLP(nn.Module):
+    """Switch-MoE feed-forward: [B, S, E] → [B, S, E].
+
+    One expert (GLU MLP) per device on ``axis_name``; ``capacity_factor``
+    bounds each expert's per-call token budget.
+    """
+
+    embed_dim: int
+    mlp_dim: int
+    axis_name: str = "ep"
+    capacity_factor: float = 2.0
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        n_experts = lax.axis_size(self.axis_name)
+        b, s, d = x.shape
+
+        def expert_init(base):
+            def init(rng, shape, dtype=jnp.float32):
+                return base(shard_init_rng(rng, self.axis_name), shape,
+                            dtype)
+            return init
+
+        lecun = nn.initializers.lecun_normal()
+        router_w = self.param("router", nn.initializers.lecun_normal(),
+                              (d, n_experts), jnp.float32)
+        w_gate = self.param("gate", expert_init(lecun), (d, self.mlp_dim))
+        w_up = self.param("up", expert_init(lecun), (d, self.mlp_dim))
+        w_down = self.param("down", expert_init(lecun), (self.mlp_dim, d))
+
+        def expert_fn(params, h):
+            wg, wu, wd = params
+            h = h.astype(self.dtype)
+            return ((nn.silu(h @ wg.astype(self.dtype))
+                     * (h @ wu.astype(self.dtype)))
+                    @ wd.astype(self.dtype))
+
+        tokens = x.reshape(b * s, d)
+        out = expert_parallel_moe(
+            expert_fn, (w_gate, w_up, w_down), router_w, tokens,
+            capacity_factor=self.capacity_factor, axis_name=self.axis_name)
+        return out.reshape(b, s, d).astype(x.dtype)

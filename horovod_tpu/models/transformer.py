@@ -39,6 +39,11 @@ class TransformerConfig:
     # passes shard_index * shard_len so RoPE and the causal mask see global
     # positions.
     rope_theta: float = 10000.0
+    # Switch-MoE feed-forward: set to a bound mesh axis name (e.g. "ep") to
+    # replace the dense MLP with one expert per device on that axis
+    # (models/moe.py).  Requires calling inside shard_map.
+    moe_axis: str | None = None
+    moe_capacity_factor: float = 2.0
 
 
 def rope(x, positions, theta: float):
@@ -106,6 +111,14 @@ class Block(nn.Module):
         y = nn.RMSNorm(dtype=cfg.dtype, name="attn_norm")(x)
         x = x + Attention(cfg, name="attn")(y, positions)
         y = nn.RMSNorm(dtype=cfg.dtype, name="mlp_norm")(x)
+        if cfg.moe_axis is not None:
+            from horovod_tpu.models.moe import MoEMLP
+
+            # Residual carries over-capacity (dropped) tokens unchanged.
+            return x + MoEMLP(embed_dim=cfg.embed_dim, mlp_dim=cfg.mlp_dim,
+                              axis_name=cfg.moe_axis,
+                              capacity_factor=cfg.moe_capacity_factor,
+                              dtype=cfg.dtype, name="moe_mlp")(y)
         return x + MLP(cfg, name="mlp")(y)
 
 

@@ -38,6 +38,7 @@ from horovod_tpu.parallel.pipeline import (  # noqa: F401
 from horovod_tpu.parallel.expert import (  # noqa: F401
     expert_init_rng,
     expert_parallel_moe,
+    moe_grad_sync,
     switch_route,
 )
 from horovod_tpu.parallel.zero import zero_optimizer  # noqa: F401

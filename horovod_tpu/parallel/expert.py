@@ -55,6 +55,40 @@ def switch_route(x, router_w, n_experts: int, capacity: int):
     return combine, gate.astype(jnp.float32)
 
 
+def moe_grad_sync(grads, axis_name: str = EP_AXIS,
+                  is_expert: Callable | None = None):
+    """Make a mixed replicated/expert gradient tree exact under
+    shard_map(check_vma=False).
+
+    Data-parallel-over-``ep`` MoE training has two gradient species:
+
+    * shared (replicated) params — each device holds only its local batch's
+      contribution → average with ``pmean`` (plain DP semantics);
+    * expert weights — the alltoall transpose already accumulated every
+      device's contribution, AND check_vma=False's psum-transposes-to-psum
+      seeded each device's loss cotangent at 1 instead of 1/K, so the
+      accumulated grad is K× the true gradient → divide by K.
+
+    After this, both species equal the true gradient of the pmean-ed loss
+    (finite-difference-tested in tests/test_moe_model.py).
+
+    ``is_expert(path) -> bool`` selects expert leaves from the
+    ``jax.tree_util`` key path; the default matches leaves under a module
+    scope containing "moe" whose own name is not "router".
+    """
+    k = lax.axis_size(axis_name)
+
+    def default_is_expert(path):
+        names = [str(getattr(p, "key", p)) for p in path]
+        return (any("moe" in n for n in names)
+                and names[-1] != "router")
+
+    pred = is_expert or default_is_expert
+    return jax.tree_util.tree_map_with_path(
+        lambda path, g: g / k if pred(path) else lax.pmean(g, axis_name),
+        grads)
+
+
 def expert_parallel_moe(expert_fn: Callable, expert_params, router_w, x,
                         capacity_factor: float = 1.0,
                         axis_name: str = EP_AXIS):

@@ -70,3 +70,8 @@ def test_tf_keras_mnist():
     out = _run("tf_keras_mnist.py", "--epochs", "1", "--warmup-epochs", "1",
                "--batch-size", "64")
     assert "finished gradual learning rate warmup" in out
+
+
+def test_jax_moe_transformer():
+    out = _run("jax_moe_transformer.py", "--steps", "12")
+    assert "improved=True" in out
