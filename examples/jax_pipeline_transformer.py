@@ -1,0 +1,133 @@
+"""Pipeline-parallel transformer training — GPipe over a ``pp`` mesh axis.
+
+A tiny LM whose blocks are split across pipeline stages: embedding and head
+replicated on every device, the transformer blocks pipelined with
+``parallel.pipeline_apply`` (one stage of ``num_layers // P`` blocks per
+device, microbatches rotating on ``ppermute``).  Beyond reference scope —
+demonstrates the pipeline surface the same way jax_moe_transformer.py
+demonstrates expert parallelism.
+
+Gradient plumbing (contracts from parallel/pipeline.py, pinned in
+tests/test_pipeline.py): stage params differentiate exactly in place; the
+embedding's gradient arrives entirely on stage 0, so it is psum-ed over the
+pipeline axis; the head sees replicated outputs and needs no sync.
+
+Run:  python examples/jax_pipeline_transformer.py [--steps 20] [--stages 4]
+(on CPU: XLA_FLAGS=--xla_force_host_platform_device_count=8)
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.models.transformer import Block, TransformerConfig
+from horovod_tpu.parallel import pipeline_apply, stage_init_rng
+
+
+class Stage(nn.Module):
+    """One pipeline stage: ``layers`` consecutive transformer blocks."""
+
+    cfg: TransformerConfig
+    layers: int
+
+    @nn.compact
+    def __call__(self, x, positions):
+        for i in range(self.layers):
+            x = Block(self.cfg, name=f"block_{i}")(x, positions)
+        return x
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--stages", type=int, default=4)
+    ap.add_argument("--layers-per-stage", type=int, default=1)
+    ap.add_argument("--microbatches", type=int, default=4)
+    ap.add_argument("--seq-len", type=int, default=16)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--vocab", type=int, default=128)
+    args = ap.parse_args()
+
+    hvd.init()
+    devs = jax.devices()
+    if len(devs) < args.stages:
+        raise SystemExit(f"need {args.stages} devices, have {len(devs)}")
+    mesh = Mesh(np.array(devs[: args.stages]), ("pp",))
+
+    cfg = TransformerConfig(vocab_size=args.vocab, num_layers=1, num_heads=4,
+                            head_dim=8, embed_dim=32, mlp_dim=64,
+                            dtype=jnp.float32)
+    stage = Stage(cfg, args.layers_per_stage)
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, args.vocab,
+                                     (args.batch, args.seq_len)))
+
+    def train(tokens):
+        b, s = tokens.shape
+        positions_full = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+        mb_rows = b // args.microbatches
+        positions_mb = positions_full[:mb_rows]
+
+        embed = nn.Embed(cfg.vocab_size, cfg.embed_dim, dtype=cfg.dtype)
+        head = nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32)
+        norm = nn.RMSNorm(dtype=cfg.dtype)
+
+        key = jax.random.PRNGKey(0)
+        emb_p = embed.init(key, tokens)
+        x0 = embed.apply(emb_p, tokens)
+        # DISTINCT per-stage block params (stage_init_rng folding).
+        stage_p = stage.init(stage_init_rng(key, "pp"), x0[:mb_rows],
+                             positions_mb)
+        norm_p = norm.init(jax.random.fold_in(key, 1), x0)
+        head_p = head.init(jax.random.fold_in(key, 2), x0)
+        params = {"embed": emb_p, "stage": stage_p, "norm": norm_p,
+                  "head": head_p}
+        opt = optax.adam(1e-3)
+        opt_state = opt.init(params)
+
+        def loss_fn(p):
+            x = embed.apply(p["embed"], tokens)
+            y = pipeline_apply(
+                lambda sp, mb: stage.apply(sp, mb, positions_mb),
+                p["stage"], x, num_microbatches=args.microbatches)
+            logits = head.apply(p["head"],
+                                norm.apply(p["norm"], y).astype(jnp.float32))
+            return jax.lax.pmean(
+                optax.softmax_cross_entropy_with_integer_labels(
+                    logits[:, :-1], tokens[:, 1:]).mean(), "pp")
+
+        def body(carry, _):
+            params, opt_state = carry
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            # Embedding grads land on stage 0 only — reduce over the axis.
+            grads["embed"] = jax.tree.map(
+                lambda g: jax.lax.psum(g, "pp"), grads["embed"])
+            updates, opt_state = opt.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), opt_state), loss
+
+        (_, _), losses = jax.lax.scan(body, (params, opt_state), None,
+                                      length=args.steps)
+        return losses
+
+    losses = jax.jit(jax.shard_map(train, mesh=mesh, in_specs=P(),
+                                   out_specs=P(), check_vma=False))(tokens)
+    losses = np.asarray(losses)
+    if hvd.rank() == 0:
+        for i in range(0, args.steps, 5):
+            print(f"step {i}: loss={losses[i]:.4f}", flush=True)
+        print(f"pipeline training ({args.stages} stages, "
+              f"{args.microbatches} microbatches): first={losses[0]:.4f} "
+              f"last={losses[-1]:.4f} improved={bool(losses[-1] < losses[0])}",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -76,6 +76,15 @@ def pipeline_apply(stage_fn: Callable, params, x,
     from them on every device must be ``lax.pmean``-ed over the pipeline
     axis — the standard replicated-compute convention — or the psum
     transpose sums P identical cotangents and every gradient comes out P×.
+
+    Gradient contracts (all verified in tests/test_pipeline.py):
+    * stage ``params``: exact true gradient on each stage's own device;
+    * input ``x``: the true gradient lands ENTIRELY on stage 0 (zeros
+      elsewhere — only its injections consumed x), so parameters of a
+      replicated producer feeding the pipeline (e.g. an embedding) need a
+      ``lax.psum`` of their gradient over the axis;
+    * a replicated consumer of the outputs (e.g. an lm head) already gets
+      the true gradient on every device — no sync needed.
     """
     n_stages = lax.axis_size(axis_name)
     stage = lax.axis_index(axis_name)

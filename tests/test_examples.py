@@ -75,3 +75,8 @@ def test_tf_keras_mnist():
 def test_jax_moe_transformer():
     out = _run("jax_moe_transformer.py", "--steps", "12")
     assert "improved=True" in out
+
+
+def test_jax_pipeline_transformer():
+    out = _run("jax_pipeline_transformer.py", "--steps", "12")
+    assert "improved=True" in out

@@ -111,3 +111,32 @@ def test_pipeline_rejects_bad_microbatch(hvd):
     with pytest.raises(ValueError, match="divisible"):
         jax.shard_map(run, mesh=mesh, in_specs=P(), out_specs=P(),
                       check_vma=False)(x)
+
+
+def test_pipeline_input_grad_lands_on_stage_zero(hvd):
+    """Contract: d(loss)/dx is exact on stage 0 and zero elsewhere, so a
+    replicated producer's param grads need a psum over the pipeline axis."""
+    mesh = _make_mesh()
+    x = jax.random.normal(jax.random.PRNGKey(3), (8, DIM))
+
+    def run(x):
+        params = _init_stage_params()
+
+        def loss_fn(x):
+            out = pipeline_apply(_stage_fn, params, x, num_microbatches=4)
+            return jax.lax.pmean(jnp.sum(out ** 2), "pp")
+
+        dx = jax.grad(loss_fn)(x)
+        return dx, params
+
+    dx, (all_w, all_b) = jax.jit(jax.shard_map(
+        run, mesh=mesh, in_specs=P(),
+        out_specs=(P("pp"), (P("pp"), P("pp"))), check_vma=False))(x)
+    dx = np.asarray(dx).reshape(N_STAGES, 8, DIM)
+    all_w = jnp.asarray(np.asarray(all_w).reshape(N_STAGES, DIM, DIM))
+    all_b = jnp.asarray(np.asarray(all_b).reshape(N_STAGES, DIM))
+    ref = np.asarray(jax.grad(
+        lambda x: jnp.sum(_sequential(all_w, all_b, x) ** 2))(x))
+    np.testing.assert_allclose(dx[0], ref, atol=1e-5, rtol=1e-5)
+    for d in range(1, N_STAGES):
+        np.testing.assert_array_equal(dx[d], np.zeros_like(ref))
