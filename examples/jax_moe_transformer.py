@@ -29,7 +29,8 @@ def main():
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--experts", type=int, default=4)
     ap.add_argument("--seq-len", type=int, default=32)
-    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--batch", type=int, default=None,
+                    help="global batch; default = one row per expert")
     ap.add_argument("--vocab", type=int, default=256)
     args = ap.parse_args()
 
@@ -37,6 +38,12 @@ def main():
     devs = jax.devices()
     if len(devs) < args.experts:
         raise SystemExit(f"need {args.experts} devices, have {len(devs)}")
+    if args.batch is None:
+        args.batch = args.experts
+    if args.batch % args.experts:
+        raise SystemExit(
+            f"--batch {args.batch} must be divisible by --experts "
+            f"{args.experts} (tokens are data-sharded over the ep axis)")
     mesh = Mesh(np.array(devs[: args.experts]), ("ep",))
 
     cfg = TransformerConfig(

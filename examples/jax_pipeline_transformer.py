@@ -62,7 +62,9 @@ def main():
         raise SystemExit(f"need {args.stages} devices, have {len(devs)}")
     mesh = Mesh(np.array(devs[: args.stages]), ("pp",))
 
-    cfg = TransformerConfig(vocab_size=args.vocab, num_layers=1, num_heads=4,
+    # num_layers is unused here: depth = --stages x --layers-per-stage (the
+    # Stage module instantiates Blocks directly).
+    cfg = TransformerConfig(vocab_size=args.vocab, num_heads=4,
                             head_dim=8, embed_dim=32, mlp_dim=64,
                             dtype=jnp.float32)
     stage = Stage(cfg, args.layers_per_stage)

@@ -43,6 +43,9 @@ class MoEMLP(nn.Module):
     def __call__(self, x):
         n_experts = lax.axis_size(self.axis_name)
         b, s, d = x.shape
+        if d != self.embed_dim:
+            raise ValueError(
+                f"MoEMLP(embed_dim={self.embed_dim}) got feature dim {d}")
 
         def expert_init(base):
             def init(rng, shape, dtype=jnp.float32):

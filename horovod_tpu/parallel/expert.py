@@ -79,7 +79,9 @@ def moe_grad_sync(grads, axis_name: str = EP_AXIS,
     k = lax.axis_size(axis_name)
 
     def default_is_expert(path):
-        names = [str(getattr(p, "key", p)) for p in path]
+        # Case-insensitive: matches both an explicit name="moe_mlp" and
+        # flax's auto-assigned "MoEMLP_0".
+        names = [str(getattr(p, "key", p)).lower() for p in path]
         return (any("moe" in n for n in names)
                 and names[-1] != "router")
 
