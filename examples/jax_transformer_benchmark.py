@@ -49,6 +49,10 @@ def main():
     ap.add_argument("--block-k", type=int, default=512)
     ap.add_argument("--peak-tflops", type=float, default=197.0,
                     help="bf16 peak of the chip (v5e default)")
+    ap.add_argument("--steps-per-call", type=int, default=4,
+                    help="training steps per dispatched program (lax.scan "
+                         "device loop — amortizes per-dispatch latency, "
+                         "same as bench.py's BENCH_STEPS_PER_CALL)")
     args = ap.parse_args()
 
     hvd.init()
@@ -74,18 +78,27 @@ def main():
     # data axis, gradients averaged by DistributedOptimizer inside the step.
     from jax.sharding import PartitionSpec as P
 
+    K = max(1, args.steps_per_call)
+
     @jax.jit
     @hvd.shard(in_specs=(P(), P(), hvd.batch_spec(2)),
                out_specs=(P(), P(), P()))
     def train_step(params, opt_state, tokens):
-        def loss_fn(p):
-            logits = model.apply(p, tokens)
-            return optax.softmax_cross_entropy_with_integer_labels(
-                logits[:, :-1], tokens[:, 1:]).mean()
+        def one(carry, _):
+            params, opt_state = carry
 
-        loss, grads = jax.value_and_grad(loss_fn)(params)
-        updates, opt_state = opt.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state, loss
+            def loss_fn(p):
+                logits = model.apply(p, tokens)
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits[:, :-1], tokens[:, 1:]).mean()
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), opt_state), loss
+
+        (params, opt_state), losses = jax.lax.scan(
+            one, (params, opt_state), None, length=K)
+        return params, opt_state, losses[-1]
 
     rng = np.random.RandomState(0)
     tokens = jnp.asarray(rng.randint(0, args.vocab,
@@ -105,7 +118,7 @@ def main():
         float(loss)
         dt = time.perf_counter() - t0
         rates.append(args.batch * args.seq_len
-                     * args.num_batches_per_iter / dt)
+                     * args.num_batches_per_iter * K / dt)
 
     tok_s = float(np.mean(rates))
     # 6N matmul FLOPs/token + causal attention FLOPs/token.
