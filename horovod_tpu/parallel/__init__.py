@@ -25,6 +25,7 @@ from horovod_tpu.parallel.ring_attention import (  # noqa: F401
 from horovod_tpu.parallel.ulysses import (  # noqa: F401
     ulysses_attention,
     make_ulysses_attention,
+    make_ulysses_flash_attention,
 )
 from horovod_tpu.parallel.tensor_parallel import (  # noqa: F401
     ColumnParallelDense,

@@ -48,3 +48,15 @@ def make_ulysses_attention(axis_name: str, inner=dense_causal_attention):
     """Adapter producing a ``TransformerConfig.attention_fn``."""
     return functools.partial(ulysses_attention, axis_name=axis_name,
                              inner=inner)
+
+
+def make_ulysses_flash_attention(axis_name: str, block_q: int = 128,
+                                 block_k: int = 128):
+    """Ulysses with the fused flash kernel as the local attention: after
+    the head exchange each chip holds the FULL sequence for H/n heads, so
+    the O(S·D)-memory kernel (fwd + fused bwd, causal-bounded) applies
+    directly — the memory-sane long-context configuration."""
+    from horovod_tpu.ops.flash_attention import make_flash_attention
+
+    return make_ulysses_attention(
+        axis_name, inner=make_flash_attention(block_q, block_k))
