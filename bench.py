@@ -30,7 +30,7 @@ def main() -> None:
     import jax.numpy as jnp
     import numpy as np
     import optax
-    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
     import horovod_tpu as hvd
     from horovod_tpu.models import ResNet50

@@ -19,9 +19,6 @@ schedule closure.
 
 from __future__ import annotations
 
-import math
-from typing import Any
-
 import jax
 import numpy as np
 

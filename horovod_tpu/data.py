@@ -14,7 +14,7 @@ JAX, at both granularities:
 
 from __future__ import annotations
 
-from typing import Iterable, Iterator, Sequence
+from typing import Iterator, Sequence
 
 import numpy as np
 

@@ -19,7 +19,6 @@ from __future__ import annotations
 from typing import Callable
 
 import flax.linen as nn
-import jax
 import jax.numpy as jnp
 from jax import lax
 

@@ -15,7 +15,6 @@ from __future__ import annotations
 
 import functools
 
-import jax
 from jax import lax
 
 from horovod_tpu.models.transformer import dense_causal_attention
