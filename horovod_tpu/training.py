@@ -49,6 +49,7 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
                          average: bool = True,
                          compression=Compression.none,
                          threshold_bytes: int | None = None,
+                         sharded_state: bool = False,
                          ) -> optax.GradientTransformation:
     """Wrap ``optimizer`` so updates see globally-averaged gradients.
 
@@ -62,7 +63,16 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
     Use inside a step wrapped by :func:`horovod_tpu.shard` (in-mesh) or in a
     plain eager loop (process-level reduction) — same dual contexts as
     ``allreduce``.
+
+    ``sharded_state=True`` switches to ZeRO-1: the gradient averaging
+    becomes a reduce-scatter, the optimizer state lives sharded 1/K per
+    device, and updates all-gather back (parallel/zero.py; in-mesh only,
+    elementwise transforms).
     """
+    if sharded_state:
+        from horovod_tpu.parallel.zero import zero_optimizer
+
+        return zero_optimizer(optimizer, average=average)
 
     def init(params):
         return DistributedState(inner=optimizer.init(params))

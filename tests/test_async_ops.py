@@ -156,3 +156,18 @@ def test_half_converters_roundtrip():
     import ml_dtypes
     np.testing.assert_array_equal(
         backb, src.astype(ml_dtypes.bfloat16).astype(np.float32))
+
+
+@pytest.mark.parametrize("dtype", ["uint8", "int8", "int32", "int64",
+                                   "float32", "float64", "bool"])
+def test_allreduce_wire_dtype_matrix(hvd, dtype):
+    # Every wire dtype the engine declares must round-trip the eager path
+    # (reference test_torch.py dtype matrix).
+    if dtype == "bool":
+        x = np.array([True, False, True, True])
+    else:
+        x = np.arange(4).astype(dtype)
+    h = hvd.allreduce_async(x, average=False, name=f"dt.{dtype}")
+    out = hvd.synchronize(h)
+    assert out.dtype == x.dtype
+    np.testing.assert_array_equal(out, x)

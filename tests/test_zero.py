@@ -123,3 +123,22 @@ def test_zero_mixed_dtypes_round_trip(hvd):
     updates = jax.jit(hvd.shard(step, in_specs=P(), out_specs=P()))(params)
     assert updates["w"].dtype == jnp.bfloat16
     assert updates["b"].dtype == jnp.float32
+
+
+def test_distributed_optimizer_sharded_state_flag(hvd):
+    """hvd.DistributedOptimizer(sharded_state=True) is the ZeRO-1 wrapper."""
+    import horovod_tpu as h
+
+    tx = h.DistributedOptimizer(optax.sgd(0.1), sharded_state=True)
+    params = {"w": jnp.arange(8.0)}
+
+    def step(params):
+        state = tx.init(params)
+        updates, _ = tx.update({"w": jnp.ones(8)}, state, params)
+        return optax.apply_updates(params, updates)
+
+    out = jax.jit(hvd.shard(step, in_specs=P(), out_specs=P()))(params)
+    # every device contributes grad=1; reduce-scatter sums to 8, averaging
+    # restores 1 -> sgd step of -0.1
+    np.testing.assert_allclose(np.asarray(out["w"]),
+                               np.arange(8.0) - 0.1, rtol=1e-6)
