@@ -3,11 +3,16 @@
 Analog of reference examples/pytorch_mnist.py: same model (:30-45), LR scaled
 by size, DistributedOptimizer with gradient hooks, broadcast of parameters
 and optimizer state before training (:77-80), per-process data sharding.
+With ``--ckpt-dir`` it also exercises the reference's checkpoint/resume
+contract (examples/pytorch_imagenet_resnet50.py:63-72): rank 0 writes
+torch state per epoch, and on restart every rank agrees on the resume
+epoch via broadcast before rank 0's weights are re-broadcast.
 """
 
 from __future__ import annotations
 
 import argparse
+import os
 
 import numpy as np
 import torch
@@ -41,6 +46,8 @@ def main():
     ap.add_argument("--epochs", type=int, default=2)
     ap.add_argument("--batch-size", type=int, default=64)
     ap.add_argument("--lr", type=float, default=0.01)
+    ap.add_argument("--ckpt-dir", default=None,
+                    help="enable per-epoch checkpoint + resume")
     args = ap.parse_args()
 
     hvd.init()
@@ -53,6 +60,25 @@ def main():
                                 lr=args.lr * hvd.size(), momentum=0.5)
     optimizer = hvd.DistributedOptimizer(
         optimizer, named_parameters=model.named_parameters())
+
+    # Resume: rank 0 reads the filesystem, the epoch number travels by
+    # broadcast so stale-FS workers agree (reference
+    # pytorch_imagenet_resnet50.py:63-72), then weights broadcast below.
+    resume_epoch = -1
+    if args.ckpt_dir:
+        if hvd.rank() == 0 and os.path.isdir(args.ckpt_dir):
+            for entry in os.listdir(args.ckpt_dir):
+                if entry.startswith("epoch_"):
+                    resume_epoch = max(resume_epoch,
+                                       int(entry.split("_", 1)[1]))
+        resume_epoch = hvd.broadcast_object(resume_epoch, root_rank=0)
+        if resume_epoch >= 0 and hvd.rank() == 0:
+            ck = torch.load(os.path.join(args.ckpt_dir,
+                                         f"epoch_{resume_epoch}"),
+                            weights_only=True)
+            model.load_state_dict(ck["model"])
+            print(f"resumed from epoch {resume_epoch}")
+
     hvd.broadcast_parameters(model.state_dict(), root_rank=0)
 
     # Synthetic MNIST-shaped data, sharded by rank (DistributedSampler
@@ -63,7 +89,7 @@ def main():
     x, y = x[hvd.rank()::hvd.size()], y[hvd.rank()::hvd.size()]
 
     model.train()
-    for epoch in range(args.epochs):
+    for epoch in range(resume_epoch + 1, args.epochs):
         perm = torch.randperm(len(x))
         loss = None
         for lo in range(0, len(x) - args.batch_size, args.batch_size):
@@ -72,6 +98,11 @@ def main():
             loss = F.nll_loss(model(x[idx]), y[idx])
             loss.backward()
             optimizer.step()
+        if args.ckpt_dir and hvd.rank() == 0:
+            # Rank-0-only writes (reference README.md:102-104 contract).
+            os.makedirs(args.ckpt_dir, exist_ok=True)
+            torch.save({"model": model.state_dict(), "epoch": epoch},
+                       os.path.join(args.ckpt_dir, f"epoch_{epoch}"))
         if hvd.rank() == 0:
             print(f"epoch {epoch}: loss={float(loss):.4f}")
 

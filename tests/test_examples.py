@@ -80,3 +80,11 @@ def test_jax_moe_transformer():
 def test_jax_pipeline_transformer():
     out = _run("jax_pipeline_transformer.py", "--steps", "12")
     assert "improved=True" in out
+
+
+def test_torch_mnist_resume(tmp_path):
+    ck = str(tmp_path / "tck")
+    _run("torch_mnist.py", "--epochs", "1", "--ckpt-dir", ck)
+    out = _run("torch_mnist.py", "--epochs", "2", "--ckpt-dir", ck)
+    assert "resumed from epoch 0" in out
+    assert "epoch 1:" in out and "epoch 0:" not in out
