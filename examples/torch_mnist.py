@@ -69,17 +69,24 @@ def main():
         if hvd.rank() == 0 and os.path.isdir(args.ckpt_dir):
             for entry in os.listdir(args.ckpt_dir):
                 if entry.startswith("epoch_"):
-                    resume_epoch = max(resume_epoch,
-                                       int(entry.split("_", 1)[1]))
+                    try:
+                        resume_epoch = max(resume_epoch,
+                                           int(entry.split("_", 1)[1]))
+                    except ValueError:
+                        pass  # stray/partial files don't break startup
         resume_epoch = hvd.broadcast_object(resume_epoch, root_rank=0)
         if resume_epoch >= 0 and hvd.rank() == 0:
             ck = torch.load(os.path.join(args.ckpt_dir,
                                          f"epoch_{resume_epoch}"),
                             weights_only=True)
             model.load_state_dict(ck["model"])
+            optimizer.load_state_dict(ck["optimizer"])
             print(f"resumed from epoch {resume_epoch}")
 
     hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    # Momentum buffers must resume too or the trajectory diverges from an
+    # uninterrupted run (reference broadcast_optimizer_state after load).
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
 
     # Synthetic MNIST-shaped data, sharded by rank (DistributedSampler
     # analog, reference :50-56).
@@ -99,10 +106,16 @@ def main():
             loss.backward()
             optimizer.step()
         if args.ckpt_dir and hvd.rank() == 0:
-            # Rank-0-only writes (reference README.md:102-104 contract).
+            # Rank-0-only writes (reference README.md:102-104 contract),
+            # atomically: a crash mid-save must not leave a truncated file
+            # that the resume scan would pick up.
             os.makedirs(args.ckpt_dir, exist_ok=True)
-            torch.save({"model": model.state_dict(), "epoch": epoch},
-                       os.path.join(args.ckpt_dir, f"epoch_{epoch}"))
+            final = os.path.join(args.ckpt_dir, f"epoch_{epoch}")
+            tmp = final + ".tmp"
+            torch.save({"model": model.state_dict(),
+                        "optimizer": optimizer.state_dict(),
+                        "epoch": epoch}, tmp)
+            os.replace(tmp, final)
         if hvd.rank() == 0:
             print(f"epoch {epoch}: loss={float(loss):.4f}")
 

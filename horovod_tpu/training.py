@@ -70,6 +70,11 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
     elementwise transforms).
     """
     if sharded_state:
+        if compression is not Compression.none or threshold_bytes is not None:
+            raise ValueError(
+                "sharded_state=True uses a reduce-scatter of the flat "
+                "gradient vector; compression/threshold_bytes do not apply "
+                "to that path — drop them or use the replicated optimizer.")
         from horovod_tpu.parallel.zero import zero_optimizer
 
         return zero_optimizer(optimizer, average=average)
