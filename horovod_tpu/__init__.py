@@ -65,6 +65,7 @@ from horovod_tpu.ops import (  # noqa: F401
 )
 from horovod_tpu.training import (  # noqa: F401
     DistributedOptimizer,
+    allgather_object,
     broadcast_object,
     broadcast_optimizer_state,
     broadcast_parameters,

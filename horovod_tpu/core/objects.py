@@ -33,3 +33,29 @@ def broadcast_object(obj, root_rank: int = 0, name: str = "bcast_obj"):
     h = eng.enqueue(name + ".data", payload, engine_mod.OP_BROADCAST,
                     root_rank=root_rank)
     return pickle.loads(eng.synchronize(h).tobytes())
+
+
+def allgather_object(obj, name: str = "agather_obj") -> list:
+    """Gather one picklable object per process; returns them rank-ordered.
+
+    (Modern-reference ``hvd.allgather_object`` surface.)  Rides the
+    engine's ragged allgather — per-rank pickle sizes may differ — with a
+    companion size gather to slice the concatenated payload.
+    """
+    from horovod_tpu import basics
+    from horovod_tpu.core import engine as engine_mod
+
+    if basics.size() == 1:
+        return [obj]
+    eng = engine_mod.get_engine()
+    payload = np.frombuffer(pickle.dumps(obj), np.uint8).copy()
+    h_len = eng.enqueue(name + ".len", np.array([payload.size], np.int64),
+                        engine_mod.OP_ALLGATHER)
+    h = eng.enqueue(name + ".data", payload, engine_mod.OP_ALLGATHER)
+    sizes = [int(s) for s in eng.synchronize(h_len)]
+    blob = eng.synchronize(h).tobytes()
+    out, off = [], 0
+    for s in sizes:
+        out.append(pickle.loads(blob[off:off + s]))
+        off += s
+    return out

@@ -17,6 +17,7 @@ import itertools
 import numpy as np
 import tensorflow as tf
 
+from horovod_tpu.core.objects import allgather_object as _allgather_object
 from horovod_tpu.core.objects import broadcast_object as _broadcast_object
 
 _bcast_counter = itertools.count()
@@ -287,6 +288,14 @@ def DistributedGradientTape(gradtape, device_dense='', device_sparse='',
     ``DistributedOptimizer.compute_gradients``."""
     return _DistributedGradientTape(gradtape, device_dense, device_sparse,
                                     compression, sparse_as_dense)
+
+
+def allgather_object(obj, name=None):
+    """Gather one picklable object per process, rank-ordered (modern
+    reference ``hvd.allgather_object``)."""
+    if size() == 1:
+        return [obj]
+    return _allgather_object(obj, name=name or "tf.agather_obj")
 
 
 def broadcast_object(obj, root_rank=0, name=None):

@@ -53,6 +53,7 @@ from horovod_tpu.torch.mpi_ops import (  # noqa: F401
 )
 from horovod_tpu.torch.optimizer import DistributedOptimizer  # noqa: F401
 from horovod_tpu.torch.state import (  # noqa: F401
+    allgather_object,
     broadcast_object,
     broadcast_optimizer_state,
     broadcast_parameters,

@@ -125,3 +125,11 @@ def broadcast_object(obj, root_rank: int = 0):
     from horovod_tpu.core.objects import broadcast_object as _bo
 
     return _bo(obj, root_rank, name="bcast_obj")
+
+
+def allgather_object(obj):
+    """Gather one picklable object per process, rank-ordered (modern
+    reference ``hvd.allgather_object``; shared engine-level scheme)."""
+    from horovod_tpu.core.objects import allgather_object as _ao
+
+    return _ao(obj)

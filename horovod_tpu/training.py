@@ -131,6 +131,16 @@ def broadcast_optimizer_state(opt_state, root_rank: int = 0):
     return jax.tree.map(bcast_leaf, opt_state)
 
 
+def allgather_object(obj):
+    """Gather one picklable object per process, rank-ordered (modern
+    reference ``hvd.allgather_object``); engine-level ragged gather."""
+    from horovod_tpu.core.objects import allgather_object as _ao
+
+    if basics.size() == 1:
+        return [obj]
+    return _ao(obj)
+
+
 def broadcast_object(obj, root_rank: int = 0):
     """Broadcast an arbitrary picklable object across processes.
 

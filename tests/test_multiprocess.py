@@ -293,3 +293,15 @@ def test_two_process_under_tsan():
         for chunk in err.split("WARNING: ThreadSanitizer")[1:]:
             assert "hvdcore" not in chunk.split("=" * 18)[0], (
                 f"tsan race in libhvdcore on rank {r}:\n{chunk[:4000]}")
+
+
+OBJ_WORKER = PRELUDE + textwrap.dedent("""
+    from horovod_tpu import allgather_object
+    out = allgather_object({"rank": rank, "data": list(range(rank + 1))})
+    assert out == [{"rank": r, "data": list(range(r + 1))} for r in range(n)], out
+    print(f"RANK{rank} OK", flush=True)
+""")
+
+
+def test_allgather_object_across_processes():
+    _run_workers(OBJ_WORKER, 2)

@@ -216,3 +216,8 @@ def test_alltoall_identity(hvdt):
     x = torch.arange(12.).reshape(4, 3)
     torch.testing.assert_close(hvdt.alltoall(x), x)
     torch.testing.assert_close(hvdt.alltoall(x, splits=torch.tensor([4])), x)
+
+
+def test_allgather_object(hvdt):
+    assert hvdt.allgather_object({"rank": 0, "v": [1, 2]}) == [
+        {"rank": 0, "v": [1, 2]}]
