@@ -142,3 +142,35 @@ def test_distributed_optimizer_sharded_state_flag(hvd):
     # restores 1 -> sgd step of -0.1
     np.testing.assert_allclose(np.asarray(out["w"]),
                                np.arange(8.0) - 0.1, rtol=1e-6)
+
+
+def test_zero_hierarchical_axes(hvd):
+    """ZeRO over a 2-D (dcn, ici) data mesh: shard index linearizes across
+    both axes; training still matches replicated adam."""
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devs, ("dcn", "ici"))
+    params = {"w": jnp.arange(12.0), "b": jnp.ones((5,))}
+    ztx = zero_optimizer(optax.adam(1e-2), axis_name=("dcn", "ici"))
+
+    def steps(params):
+        state = ztx.init(params)
+        for _ in range(2):
+            grads = jax.tree.map(lambda p: p * 0.1, params)
+            updates, state = ztx.update(grads, state, params)
+            params = optax.apply_updates(params, updates)
+        return params
+
+    out = jax.jit(jax.shard_map(steps, mesh=mesh, in_specs=P(),
+                                out_specs=P(), check_vma=False))(params)
+
+    tx = optax.adam(1e-2)
+    p = params
+    st = tx.init(p)
+    for _ in range(2):
+        u, st = tx.update(jax.tree.map(lambda q: q * 0.1, p), st, p)
+        p = optax.apply_updates(p, u)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(out[k]), np.asarray(p[k]),
+                                   atol=1e-6)
