@@ -59,7 +59,9 @@ def main():
     cfg = dict(vocab_size=args.vocab, num_layers=args.layers,
                num_heads=args.heads, head_dim=args.embed // args.heads,
                embed_dim=args.embed, mlp_dim=4 * args.embed,
-               max_seq_len=args.seq_len, dtype=jnp.bfloat16)
+               max_seq_len=args.seq_len, dtype=jnp.bfloat16,
+               # bf16 logits buffer (f32 softmax via the fused upcast below)
+               logits_dtype=jnp.bfloat16)
     attn = None if args.no_flash else make_flash_attention(
         block_q=args.block_q, block_k=args.block_k)
     model = Transformer(TransformerConfig(
@@ -89,8 +91,10 @@ def main():
 
             def loss_fn(p):
                 logits = model.apply(p, tokens)
+                # f32 softmax numerics; the cast fuses into the CE chain so
+                # only the bf16 logits buffer ever reaches HBM.
                 return optax.softmax_cross_entropy_with_integer_labels(
-                    logits[:, :-1], tokens[:, 1:]).mean()
+                    logits[:, :-1].astype(jnp.float32), tokens[:, 1:]).mean()
 
             loss, grads = jax.value_and_grad(loss_fn)(params)
             updates, opt_state = opt.update(grads, opt_state, params)
