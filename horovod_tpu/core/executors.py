@@ -65,6 +65,24 @@ def _staged_f32_sum(rows: np.ndarray) -> np.ndarray:
     return out
 
 
+def _as_wire(a: np.ndarray) -> tuple[np.ndarray, np.dtype]:
+    """Byte-safe wire representation for the jax transport.
+
+    Without ``jax_enable_x64``, ``jnp.asarray`` silently DOWNCASTS 64-bit
+    arrays to 32-bit — corrupting int64/float64 collectives.  8-byte dtypes
+    therefore travel as a uint8 view (last axis ×8, trailing shapes stay
+    consistent for ragged gathers) and are re-viewed on arrival."""
+    if a.dtype.itemsize == 8:
+        return np.ascontiguousarray(a).view(np.uint8), a.dtype
+    return a, a.dtype
+
+
+def _from_wire(a: np.ndarray, dtype: np.dtype) -> np.ndarray:
+    if dtype.itemsize == 8:
+        return np.ascontiguousarray(a).view(dtype)
+    return a
+
+
 def multihost_executor(engine, batch) -> None:
     import jax.numpy as jnp
     from jax.experimental import multihost_utils
@@ -81,13 +99,16 @@ def multihost_executor(engine, batch) -> None:
         engine.batch_activity(batch, "MEMCPY_IN_FUSION_BUFFER")
         flat = np.concatenate([a.ravel() for a in inputs])
         engine.batch_activity(batch, "PROCESS_ALLREDUCE")
+        wire, dtype = _as_wire(flat)
         gathered = multihost_utils.process_allgather(
-            jnp.asarray(flat)[None], tiled=False)
-        rows = np.asarray(gathered).reshape(size, -1)
+            jnp.asarray(wire)[None], tiled=False)
+        rows = _from_wire(np.asarray(gathered).reshape(size, -1), dtype)
         if rows.dtype.name in ("float16", "bfloat16"):
             # Half-precision wire, float32 accumulation (half.cc staging).
             summed = _staged_f32_sum(rows)
         else:
+            # Host-side numpy sum: full precision for every dtype incl.
+            # int64/float64 (the reduction never runs in a downcast dtype).
             summed = rows.sum(axis=0).astype(flat.dtype)
         engine.batch_activity(batch, "MEMCPY_OUT_FUSION_BUFFER")
         outs = []
@@ -110,16 +131,28 @@ def multihost_executor(engine, batch) -> None:
         max_d = max(sizes) if sizes else a.shape[0]
         pad = [(0, max_d - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
         padded = np.pad(a, pad)
-        gathered = np.asarray(multihost_utils.process_allgather(
-            jnp.asarray(padded)[None], tiled=False))
+        if a.dtype.itemsize == 8 and padded.size > 0:
+            # 64-bit dtypes ride as a uint8 view on the trailing axis (dim 0
+            # keeps its row meaning for the per-rank slicing below).
+            wire = np.ascontiguousarray(
+                padded.reshape(max_d, -1)).view(np.uint8)
+            gathered = np.asarray(multihost_utils.process_allgather(
+                jnp.asarray(wire)[None], tiled=False))
+            gathered = np.ascontiguousarray(
+                gathered.reshape(size, max_d, -1)).view(a.dtype)
+        else:
+            gathered = np.asarray(multihost_utils.process_allgather(
+                jnp.asarray(padded)[None], tiled=False))
         gathered = gathered.reshape((size, max_d) + a.shape[1:])
         pieces = [gathered[r, : sizes[r]] for r in range(size)]
         engine.put_results(batch, [np.concatenate(pieces, axis=0)])
     elif batch.type == engine_mod.OP_BROADCAST:
         engine.batch_activity(batch, "PROCESS_BROADCAST")
         a = inputs[0]
-        out = np.asarray(multihost_utils.broadcast_one_to_all(
-            jnp.asarray(a), is_source=engine.rank == batch.root_rank))
+        wire, dtype = _as_wire(a)
+        out = _from_wire(np.asarray(multihost_utils.broadcast_one_to_all(
+            jnp.asarray(wire), is_source=engine.rank == batch.root_rank)),
+            dtype).reshape(a.shape)
         engine.put_results(batch, [out])
     else:
         raise NotImplementedError(f"batch type {batch.type}")

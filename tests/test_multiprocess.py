@@ -74,6 +74,20 @@ WORKER = PRELUDE + textwrap.dedent("""
     assert out16.dtype == np.float16
     np.testing.assert_allclose(out16.astype(np.float32), np.full(4, float(S)))
 
+    # 64-bit wire exactness: int64/float64 must NOT downcast through the
+    # jax transport (byte-view wire, executors._as_wire).
+    big = 2 ** 40 + 7  # unrepresentable in float32
+    h = hvd.allreduce_async(np.full(3, big + rank, np.int64),
+                            average=False, name="mp.ar64")
+    out64 = hvd.synchronize(h)
+    assert out64.dtype == np.int64
+    expect64 = sum(big + r for r in range(n))
+    np.testing.assert_array_equal(out64, np.full(3, expect64, np.int64))
+    h = hvd.broadcast_async(np.array([0.1], np.float64), root_rank=0,
+                            name="mp.bc64")
+    outf = hvd.synchronize(h)
+    assert outf.dtype == np.float64 and float(outf[0]) == 0.1
+
     # ragged allgather: rank r contributes r+1 rows
     rows = np.arange((rank + 1) * 3, dtype=np.float32).reshape(rank + 1, 3)
     h = hvd.allgather_async(rows, name="mp.ag")
@@ -107,7 +121,7 @@ WORKER = PRELUDE + textwrap.dedent("""
     torch.manual_seed(rank)        # different init per rank on purpose
     model = torch.nn.Linear(4, 2)
     opt = hvdt.DistributedOptimizer(
-        torch.optim.SGD(model.parameters(), lr=0.1),
+        torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9),
         named_parameters=model.named_parameters())
     hvdt.broadcast_parameters(model.state_dict(), root_rank=0)
     torch.manual_seed(7)           # same data on all ranks
@@ -121,6 +135,32 @@ WORKER = PRELUDE + textwrap.dedent("""
     allw = hvd.synchronize(h)
     for r in range(1, n):
         np.testing.assert_allclose(allw[0], allw[r], atol=1e-6)
+
+    # optimizer-state broadcast restores root's values after perturbation
+    # (reference test_torch.py:734-866 broadcast_state, :868-935 LR option
+    # broadcast): non-root ranks mangle lr and momentum buffers, then the
+    # broadcast must re-align everyone with rank 0.
+    if rank != 0:
+        opt.param_groups[0]["lr"] = 9.9
+        for st in opt.state.values():
+            if "momentum_buffer" in st and st["momentum_buffer"] is not None:
+                st["momentum_buffer"].mul_(3.0)
+    hvdt.broadcast_optimizer_state(opt, root_rank=0)
+    assert abs(opt.param_groups[0]["lr"] - 0.1) < 1e-9, \
+        opt.param_groups[0]["lr"]
+    bufs = [st["momentum_buffer"].numpy().reshape(-1)
+            for st in opt.state.values()
+            if "momentum_buffer" in st and st["momentum_buffer"] is not None]
+    if bufs:
+        flat = np.concatenate(bufs)[None, :]
+        h = hvd.allgather_async(flat.astype(np.float32), name="mp.mbuf")
+        allb = hvd.synchronize(h)
+        for r in range(1, n):
+            np.testing.assert_allclose(allb[0], allb[r], atol=1e-6)
+
+    # per-rank object gather
+    objs = hvd.allgather_object({"r": rank})
+    assert objs == [{"r": r} for r in range(n)], objs
 
     print(f"RANK{rank} OK", flush=True)
 """)
