@@ -131,9 +131,16 @@ def multihost_executor(engine, batch) -> None:
         max_d = max(sizes) if sizes else a.shape[0]
         pad = [(0, max_d - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
         padded = np.pad(a, pad)
-        if a.dtype.itemsize == 8 and padded.size > 0:
-            # 64-bit dtypes ride as a uint8 view on the trailing axis (dim 0
-            # keeps its row meaning for the per-rank slicing below).
+        if padded.size == 0:
+            # Every rank's payload is empty (max_d or a trailing dim is 0,
+            # and both are negotiation-consistent across ranks) — nothing
+            # to move, and skipping the collective is lockstep-safe because
+            # all ranks take this branch together.  Result keeps the dtype.
+            gathered = np.zeros((size, max_d) + a.shape[1:], a.dtype)
+        elif a.dtype.itemsize == 8:
+            # 64-bit dtypes ride as a uint8 view on a flattened trailing
+            # axis (dim 0 keeps its row meaning for the per-rank slicing
+            # below; a bare view would scale dim 0 of 1-D arrays by 8).
             wire = np.ascontiguousarray(
                 padded.reshape(max_d, -1)).view(np.uint8)
             gathered = np.asarray(multihost_utils.process_allgather(

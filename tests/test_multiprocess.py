@@ -250,10 +250,13 @@ def _run_workers(script, nprocs, timeout=240, extra_env=None):
     if not all(f"RANK{r} OK" in out for r, (out, _) in enumerate(outs)):
         # Retry ONCE only on infrastructure noise (gloo/coordination
         # rendezvous timing under load), never on assertion failures —
-        # those must surface.
+        # those must surface even when a peer's death also produced a
+        # rendezvous timeout on another rank.
         infra = ("Gloo", "DEADLINE_EXCEEDED", "coordination_service",
                  "Address already in use")
-        if any(any(sig in err for sig in infra) for _, err in outs):
+        real_failure = any("AssertionError" in err for _, err in outs)
+        if not real_failure and any(
+                any(sig in err for sig in infra) for _, err in outs):
             outs = _run_workers_once(script, nprocs, timeout, extra_env)
     for r, (out, err) in enumerate(outs):
         assert f"RANK{r} OK" in out, f"rank {r} failed:\n{err[-3000:]}"
@@ -358,3 +361,25 @@ OBJ_WORKER = PRELUDE + textwrap.dedent("""
 
 def test_allgather_object_across_processes():
     _run_workers(OBJ_WORKER, 2)
+
+
+EMPTY_WORKER = PRELUDE + textwrap.dedent("""
+    # All-empty 64-bit ragged allgather must keep its dtype (the byte-wire
+    # guard must not fall through to the downcasting jnp path).
+    h = hvd.allgather_async(np.zeros((0, 3), np.int64), name="mp.empty64")
+    out = hvd.synchronize(h)
+    assert out.dtype == np.int64 and out.shape == (0, 3), (out.dtype,
+                                                           out.shape)
+    # one rank empty, one not — ragged with a 64-bit dtype
+    rows = np.full((rank, 2), 2 ** 40 + rank, np.int64)
+    h = hvd.allgather_async(rows, name="mp.some64")
+    out = hvd.synchronize(h)
+    assert out.dtype == np.int64 and out.shape == (sum(range(n)), 2)
+    if n > 1:
+        assert int(out[-1, 0]) == 2 ** 40 + (n - 1)
+    print(f"RANK{rank} OK", flush=True)
+""")
+
+
+def test_empty_and_ragged_64bit_allgather():
+    _run_workers(EMPTY_WORKER, 2)
