@@ -324,7 +324,8 @@ TSAN_WORKER = textwrap.dedent("""
 """)
 
 
-def test_two_process_under_tsan():
+@pytest.mark.parametrize("nprocs", [2, 4])
+def test_engine_under_tsan(nprocs):
     """The PARITY 'race detection' row must actually run: the native engine
     (TCP coordinator, fusion scheduler, handle table, timeline) under the
     ThreadSanitizer build with concurrent clients, asserting no data-race
@@ -338,7 +339,7 @@ def test_two_process_under_tsan():
     if not os.path.exists(TSAN_RUNTIME):
         pytest.skip("libtsan runtime not installed")
     outs = _run_workers(
-        TSAN_WORKER, 2, timeout=360,
+        TSAN_WORKER, nprocs, timeout=360,
         extra_env={"HVD_CORE_LIB": "libhvdcore_tsan.so",
                    "LD_PRELOAD": TSAN_RUNTIME,
                    "TSAN_OPTIONS": "report_bugs=1 halt_on_error=0 "
