@@ -27,11 +27,14 @@ def build_step(model, opt):
                out_specs=(P(), P(), P(), P()))
     def train_step(params, batch_stats, opt_state, x, y):
         def loss_fn(p):
-            logits, mutated = model.apply(
-                {"params": p, "batch_stats": batch_stats}, x, train=True,
-                mutable=["batch_stats"])
+            variables = {"params": p, **batch_stats}
+            if batch_stats:  # static at trace time
+                logits, mutated = model.apply(
+                    variables, x, train=True, mutable=["batch_stats"])
+            else:
+                logits, mutated = model.apply(variables, x, train=True), {}
             return optax.softmax_cross_entropy_with_integer_labels(
-                logits, y).mean(), mutated["batch_stats"]
+                logits, y).mean(), mutated
 
         (loss, new_stats), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params)
@@ -42,22 +45,35 @@ def build_step(model, opt):
     return train_step
 
 
+# Canonical benchmark resolution per model family (tf_cnn_benchmarks uses
+# 299² for inception3, 224² for everything else).
+_IMAGE_SIZE = {"InceptionV3": 299}
+
+
 def run(args, threshold: int | None = None) -> float:
     if threshold is not None:
         import os
 
         os.environ["HOROVOD_FUSION_THRESHOLD"] = str(threshold)
     model_cls = getattr(models, args.model)
-    model = model_cls(num_classes=1000, dtype=jnp.bfloat16)
+    try:  # synthetic throughput: disable dropout on models that carry it
+        model = model_cls(num_classes=1000, dtype=jnp.bfloat16,
+                          dropout_rate=0.0)
+    except TypeError:
+        model = model_cls(num_classes=1000, dtype=jnp.bfloat16)
+    size = args.image_size or _IMAGE_SIZE.get(args.model, 224)
     rng = jax.random.PRNGKey(0)
-    variables = model.init(rng, jnp.zeros((2, 224, 224, 3)), train=True)
-    params, batch_stats = variables["params"], variables["batch_stats"]
+    variables = model.init(rng, jnp.zeros((2, size, size, 3)), train=True)
+    params = variables["params"]
+    has_stats = "batch_stats" in variables
+    batch_stats = ({"batch_stats": variables["batch_stats"]}
+                   if has_stats else {})
     opt = hvd.DistributedOptimizer(optax.sgd(0.01, momentum=0.9))
     opt_state = opt.init(params)
     step = build_step(model, opt)
 
     gb = args.batch_size * hvd.num_chips()
-    x = jnp.asarray(np.random.rand(gb, 224, 224, 3), jnp.float32)
+    x = jnp.asarray(np.random.rand(gb, size, size, 3), jnp.float32)
     y = jnp.asarray(np.random.randint(0, 1000, gb))
 
     def one():
@@ -96,7 +112,11 @@ def run(args, threshold: int | None = None) -> float:
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--model", default="ResNet50")
+    ap.add_argument("--model", default="ResNet50",
+                    help="any horovod_tpu.models class: ResNet50/101, "
+                         "VGG16/19, InceptionV3, ...")
+    ap.add_argument("--image-size", type=int, default=None,
+                    help="input resolution (default: canonical per model)")
     ap.add_argument("--batch-size", type=int, default=32)
     ap.add_argument("--num-warmup-batches", type=int, default=10)
     ap.add_argument("--num-iters", type=int, default=10)

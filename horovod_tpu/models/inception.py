@@ -1,0 +1,195 @@
+"""Inception V3 — the reference's other 90%-scaling headline model.
+
+The reference's benchmark table leads with Inception V3 (reference
+README.md:45-51, docs/benchmarks.md:1-6 — 90% scaling efficiency at 512
+GPUs via tf_cnn_benchmarks ``--model inception3``).  The architecture is
+Szegedy et al. 2015; the factorised 1×7/7×1 and 1×3/3×1 convolutions that
+define it are exactly the shapes the MXU likes least, which makes it a good
+stress test that XLA's layout assignment earns its keep.
+
+TPU shaping, same recipe as :mod:`.resnet`:
+
+* **NHWC** layout, conv→BN→ReLU units with float32 BN statistics.
+* **bfloat16 compute / float32 params** via ``dtype``.
+* Stem and grid reductions use VALID padding (299² → 8×8×2048), the
+  in-module branches SAME — the tf.slim layout the reference benchmarks.
+* ``aux_logits=True`` adds the training-time auxiliary head on the 17×17
+  grid (returned as a second output); off by default for throughput work.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+def _cbr(conv: ModuleDef, norm: ModuleDef, x, features: int, kernel,
+         strides=(1, 1), padding="SAME"):
+    """conv → batch-norm → ReLU, the universal Inception unit."""
+    x = conv(features, kernel, strides, padding=padding)(x)
+    return nn.relu(norm()(x))
+
+
+def _avg_pool_same(x):
+    return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+
+
+class InceptionA(nn.Module):
+    """35×35 mixed block: 1×1 / 5×5 / double-3×3 / pooled branches."""
+
+    pool_features: int
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        b1 = _cbr(self.conv, self.norm, x, 64, (1, 1))
+        b5 = _cbr(self.conv, self.norm, x, 48, (1, 1))
+        b5 = _cbr(self.conv, self.norm, b5, 64, (5, 5))
+        b3 = _cbr(self.conv, self.norm, x, 64, (1, 1))
+        b3 = _cbr(self.conv, self.norm, b3, 96, (3, 3))
+        b3 = _cbr(self.conv, self.norm, b3, 96, (3, 3))
+        bp = _cbr(self.conv, self.norm, _avg_pool_same(x),
+                  self.pool_features, (1, 1))
+        return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+
+class ReductionA(nn.Module):
+    """35×35 → 17×17 grid reduction (stride-2 VALID branches + max-pool)."""
+
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        b3 = _cbr(self.conv, self.norm, x, 384, (3, 3), (2, 2), "VALID")
+        bd = _cbr(self.conv, self.norm, x, 64, (1, 1))
+        bd = _cbr(self.conv, self.norm, bd, 96, (3, 3))
+        bd = _cbr(self.conv, self.norm, bd, 96, (3, 3), (2, 2), "VALID")
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b3, bd, bp], axis=-1)
+
+
+class InceptionC(nn.Module):
+    """17×17 mixed block with factorised 1×7/7×1 convolutions."""
+
+    channels_7x7: int
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        c7 = self.channels_7x7
+        b1 = _cbr(self.conv, self.norm, x, 192, (1, 1))
+        b7 = _cbr(self.conv, self.norm, x, c7, (1, 1))
+        b7 = _cbr(self.conv, self.norm, b7, c7, (1, 7))
+        b7 = _cbr(self.conv, self.norm, b7, 192, (7, 1))
+        bd = _cbr(self.conv, self.norm, x, c7, (1, 1))
+        bd = _cbr(self.conv, self.norm, bd, c7, (7, 1))
+        bd = _cbr(self.conv, self.norm, bd, c7, (1, 7))
+        bd = _cbr(self.conv, self.norm, bd, c7, (7, 1))
+        bd = _cbr(self.conv, self.norm, bd, 192, (1, 7))
+        bp = _cbr(self.conv, self.norm, _avg_pool_same(x), 192, (1, 1))
+        return jnp.concatenate([b1, b7, bd, bp], axis=-1)
+
+
+class ReductionB(nn.Module):
+    """17×17 → 8×8 grid reduction."""
+
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        b3 = _cbr(self.conv, self.norm, x, 192, (1, 1))
+        b3 = _cbr(self.conv, self.norm, b3, 320, (3, 3), (2, 2), "VALID")
+        b7 = _cbr(self.conv, self.norm, x, 192, (1, 1))
+        b7 = _cbr(self.conv, self.norm, b7, 192, (1, 7))
+        b7 = _cbr(self.conv, self.norm, b7, 192, (7, 1))
+        b7 = _cbr(self.conv, self.norm, b7, 192, (3, 3), (2, 2), "VALID")
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b3, b7, bp], axis=-1)
+
+
+class InceptionE(nn.Module):
+    """8×8 mixed block with 1×3/3×1 fan-out branches (→ 2048 channels)."""
+
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        b1 = _cbr(self.conv, self.norm, x, 320, (1, 1))
+        b3 = _cbr(self.conv, self.norm, x, 384, (1, 1))
+        b3 = jnp.concatenate([
+            _cbr(self.conv, self.norm, b3, 384, (1, 3)),
+            _cbr(self.conv, self.norm, b3, 384, (3, 1))], axis=-1)
+        bd = _cbr(self.conv, self.norm, x, 448, (1, 1))
+        bd = _cbr(self.conv, self.norm, bd, 384, (3, 3))
+        bd = jnp.concatenate([
+            _cbr(self.conv, self.norm, bd, 384, (1, 3)),
+            _cbr(self.conv, self.norm, bd, 384, (3, 1))], axis=-1)
+        bp = _cbr(self.conv, self.norm, _avg_pool_same(x), 192, (1, 1))
+        return jnp.concatenate([b1, b3, bd, bp], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    """Inception V3 over NHWC inputs (canonical resolution 299×299).
+
+    Returns logits, or ``(logits, aux_logits)`` when ``aux_logits=True`` and
+    ``train=True``.  Minimum spatial input is 75×75 (the stem and two grid
+    reductions shrink by ~32×).
+    """
+
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    aux_logits: bool = False
+    dropout_rate: float = 0.0
+    axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = functools.partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            epsilon=1e-3, dtype=self.dtype, axis_name=self.axis_name)
+        x = x.astype(self.dtype)
+        # Stem: 299×299×3 → 35×35×192.
+        x = _cbr(conv, norm, x, 32, (3, 3), (2, 2), "VALID")
+        x = _cbr(conv, norm, x, 32, (3, 3), padding="VALID")
+        x = _cbr(conv, norm, x, 64, (3, 3))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = _cbr(conv, norm, x, 80, (1, 1), padding="VALID")
+        x = _cbr(conv, norm, x, 192, (3, 3), padding="VALID")
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+
+        for pool_features in (32, 64, 64):
+            x = InceptionA(pool_features, conv, norm)(x)
+        x = ReductionA(conv, norm)(x)
+        for c7 in (128, 160, 160, 192):
+            x = InceptionC(c7, conv, norm)(x)
+
+        aux = None
+        if self.aux_logits and train:
+            a = nn.avg_pool(x, (5, 5), strides=(3, 3), padding="VALID")
+            a = _cbr(conv, norm, a, 128, (1, 1))
+            a = _cbr(conv, norm, a, 768, a.shape[1:3], padding="VALID")
+            a = jnp.mean(a, axis=(1, 2))
+            aux = nn.Dense(self.num_classes, dtype=jnp.float32,
+                           name="aux_head")(a.astype(jnp.float32))
+
+        x = ReductionB(conv, norm)(x)
+        x = InceptionE(conv, norm)(x)
+        x = InceptionE(conv, norm)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        if self.dropout_rate:
+            x = nn.Dropout(self.dropout_rate,
+                           deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     name="head")(x.astype(jnp.float32))
+        return (x, aux) if aux is not None else x

@@ -1,0 +1,78 @@
+"""VGG — the bandwidth-heavy classic from the reference's benchmark table.
+
+The reference's headline numbers cover VGG-16 explicitly (reference
+README.md:50, docs/benchmarks.md:7 — 68% scaling efficiency at 512 GPUs,
+the hardest of the three headline models because its ~138 M parameters make
+the gradient allreduce enormous relative to compute).  The model itself
+lives in tf_cnn_benchmarks / torchvision in the reference world; here it is
+in-tree and TPU-shaped:
+
+* **NHWC** layout, channels-minor on the 128-wide lane dimension.
+* **bfloat16 compute / float32 params** via ``dtype`` — every conv and the
+  two 4096-wide FC matmuls hit the MXU at full rate; the classifier head
+  accumulates in float32.
+* Classic topology: plain conv+bias+ReLU stacks (no batch norm, faithful to
+  the original and to tf_cnn_benchmarks' ``vgg16``); ``batch_norm=True``
+  opts into the vgg16_bn variant.
+* The flatten→Dense classifier adapts to the input resolution (7·7·512 at
+  224²), so the same module serves tiny test shapes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# Stage widths; "M" = 2×2/2 max-pool.  (Simonyan & Zisserman configs D/E.)
+_CFG_16: tuple = (64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+                  512, 512, 512, "M", 512, 512, 512, "M")
+_CFG_19: tuple = (64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+                  512, 512, 512, 512, "M", 512, 512, 512, 512, "M")
+
+
+class VGG(nn.Module):
+    """VGG over NHWC inputs.
+
+    ``dtype`` is the compute dtype (bfloat16 recommended on TPU); parameters
+    stay float32.  Dropout (rate ``dropout_rate``) is active when
+    ``train=True`` and needs a ``"dropout"`` PRNG key; pass
+    ``dropout_rate=0.0`` for synthetic throughput runs.
+    """
+
+    cfg: Sequence = _CFG_16
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    batch_norm: bool = False
+    dropout_rate: float = 0.5
+    axis_name: str | None = None  # sync BN stats across the data axis
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(nn.Conv, kernel_size=(3, 3), padding="SAME",
+                                 use_bias=not self.batch_norm,
+                                 dtype=self.dtype)
+        norm = functools.partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            epsilon=1e-5, dtype=self.dtype, axis_name=self.axis_name)
+        x = x.astype(self.dtype)
+        for width in self.cfg:
+            if width == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = conv(width)(x)
+                if self.batch_norm:
+                    x = norm()(x)
+                x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        for _ in range(2):
+            x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        name="head")(x.astype(jnp.float32))
+
+
+VGG16 = functools.partial(VGG, cfg=_CFG_16)
+VGG19 = functools.partial(VGG, cfg=_CFG_19)
