@@ -36,6 +36,9 @@ def main():
     ap.add_argument("--flash", action="store_true",
                     help="fuse each ring step with the pallas flash kernel "
                          "(O(S/n · D) per-step memory instead of O((S/n)²))")
+    ap.add_argument("--zigzag", action="store_true",
+                    help="zigzag sequence layout: balances causal work "
+                         "across the ring (implies --flash)")
     args = ap.parse_args()
 
     hvd.init()
@@ -47,8 +50,13 @@ def main():
                 num_heads=args.heads, head_dim=args.embed // args.heads,
                 embed_dim=args.embed, mlp_dim=4 * args.embed,
                 max_seq_len=args.seq_len)
-    attn = (make_ring_flash_attention("sp") if args.flash
-            else make_ring_attention("sp"))
+    if args.zigzag:
+        from horovod_tpu.parallel import make_zigzag_ring_flash_attention
+
+        attn = make_zigzag_ring_flash_attention("sp")
+    else:
+        attn = (make_ring_flash_attention("sp") if args.flash
+                else make_ring_attention("sp"))
     model = Transformer(TransformerConfig(**base, attention_fn=attn))
     init_model = Transformer(TransformerConfig(**base))
     params = init_model.init(jax.random.PRNGKey(0),
@@ -60,10 +68,22 @@ def main():
     def train_step(params, opt_state, tokens):
         def sharded(params, tokens):
             def loss_fn(p):
-                offset = jax.lax.axis_index("sp") * s_local
-                logits = model.apply(p, tokens, position_offset=offset)
-                loss = optax.softmax_cross_entropy_with_integer_labels(
-                    logits[:, :-1], tokens[:, 1:]).mean()
+                ce = optax.softmax_cross_entropy_with_integer_labels
+                if args.zigzag:
+                    from horovod_tpu.parallel import zigzag_positions
+
+                    logits = model.apply(
+                        p, tokens, positions=zigzag_positions(s_local, "sp"))
+                    # Next-token shift is only valid within a contiguous
+                    # chunk; the zigzag shard is two chunks — shift each.
+                    c = s_local // 2
+                    loss = 0.5 * (
+                        ce(logits[:, :c - 1], tokens[:, 1:c]).mean()
+                        + ce(logits[:, c:-1], tokens[:, c + 1:]).mean())
+                else:
+                    offset = jax.lax.axis_index("sp") * s_local
+                    logits = model.apply(p, tokens, position_offset=offset)
+                    loss = ce(logits[:, :-1], tokens[:, 1:]).mean()
                 # Mean over sequence shards = global mean over the sequence.
                 return jax.lax.pmean(loss, "sp")
 
@@ -79,6 +99,10 @@ def main():
 
     rng = np.random.RandomState(0)
     tokens = jnp.asarray(rng.randint(0, 32000, (args.batch, args.seq_len)))
+    if args.zigzag:
+        from horovod_tpu.parallel import zigzag_permutation
+
+        tokens = tokens[:, zigzag_permutation(args.seq_len, n)]
     loss = None
     for i in range(args.steps):
         t0 = time.time()

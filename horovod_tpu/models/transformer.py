@@ -130,18 +130,24 @@ class Transformer(nn.Module):
     """Token ids [B, S] → logits [B, S, vocab].
 
     ``position_offset`` shifts positions for sequence-parallel shards so each
-    shard computes RoPE/causal masks at its global coordinates.
+    shard computes RoPE/causal masks at its global coordinates.  For
+    non-contiguous layouts (zigzag ring attention), pass explicit
+    ``positions`` ([S] or [B, S] global coordinates) instead — e.g.
+    ``parallel.zigzag_positions(s_local, axis)``.
     """
 
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, position_offset=0):
+    def __call__(self, tokens, position_offset=0, positions=None):
         cfg = self.cfg
         x = nn.Embed(cfg.vocab_size, cfg.embed_dim, dtype=cfg.dtype,
                      name="embed")(tokens)
-        positions = (jnp.arange(tokens.shape[1])[None, :]
-                     + jnp.asarray(position_offset))
+        if positions is None:
+            positions = (jnp.arange(tokens.shape[1])[None, :]
+                         + jnp.asarray(position_offset))
+        elif positions.ndim == 1:
+            positions = positions[None, :]
         positions = jnp.broadcast_to(positions, tokens.shape)
         for i in range(cfg.num_layers):
             x = Block(cfg, name=f"layer_{i}")(x, positions)

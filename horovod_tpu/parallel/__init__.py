@@ -19,8 +19,13 @@ from horovod_tpu.parallel.hierarchy import hierarchical_allreduce  # noqa: F401
 from horovod_tpu.parallel.ring_attention import (  # noqa: F401
     make_ring_attention,
     make_ring_flash_attention,
+    make_zigzag_ring_flash_attention,
     ring_attention,
     ring_flash_attention,
+    zigzag_inverse_permutation,
+    zigzag_permutation,
+    zigzag_positions,
+    zigzag_ring_flash_attention,
 )
 from horovod_tpu.parallel.ulysses import (  # noqa: F401
     ulysses_attention,

@@ -21,6 +21,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 NEG_INF = -1e30
@@ -98,6 +99,19 @@ def make_ring_attention(axis_name: str):
 # Ring + flash: Pallas kernel inside each ring step
 # ---------------------------------------------------------------------------
 
+def _merge_partial(out, lse, o_i, lse_i):
+    """Exact merge of two normalized partial attentions via their lse:
+    combined = (out·e^{lse} + o_i·e^{lse_i}) / (e^{lse} + e^{lse_i}),
+    computed at shifted max m.  Shapes: out [B,S,H,D]; weights [B,S,H,1]."""
+    m = jnp.maximum(lse, lse_i)
+    w_old = jnp.exp(lse - m)[..., None]
+    w_new = jnp.exp(lse_i - m)[..., None]
+    denom = jnp.maximum(w_old + w_new, 1e-30)
+    out = (out * w_old + o_i.astype(jnp.float32) * w_new) / denom
+    lse = m + jnp.log(denom[..., 0])
+    return out, lse
+
+
 def _ring_flash_forward(q, k, v, axis_name, causal, block_q, block_k):
     """Forward ring pass; returns (out_f32, merged lse)."""
     n = lax.axis_size(axis_name)
@@ -116,15 +130,7 @@ def _ring_flash_forward(q, k, v, axis_name, causal, block_q, block_k):
         o_i, lse_i = flash_attention_with_lse(
             q, k, v, causal=causal, q_offset=my * s_local,
             k_offset=owner * s_local, block_q=block_q, block_k=block_k)
-        # Exact merge of two normalized partial attentions via their lse:
-        # combined = (out·e^{lse} + o_i·e^{lse_i}) / (e^{lse} + e^{lse_i}),
-        # computed at shifted max m.  Shapes: out [B,S,H,D]; weights [B,S,H,1].
-        m = jnp.maximum(lse, lse_i)
-        w_old = jnp.exp(lse - m)[..., None]
-        w_new = jnp.exp(lse_i - m)[..., None]
-        denom = jnp.maximum(w_old + w_new, 1e-30)
-        out = (out * w_old + o_i.astype(jnp.float32) * w_new) / denom
-        lse = m + jnp.log(denom[..., 0])
+        out, lse = _merge_partial(out, lse, o_i, lse_i)
         k = lax.ppermute(k, axis_name, perm)
         v = lax.ppermute(v, axis_name, perm)
         return (k, v, out, lse), None
@@ -207,3 +213,188 @@ def make_ring_flash_attention(axis_name: str, block_q: int = 128,
     """Adapter producing a ``TransformerConfig.attention_fn``."""
     return functools.partial(ring_flash_attention, axis_name=axis_name,
                              block_q=block_q, block_k=block_k)
+
+
+# ---------------------------------------------------------------------------
+# Zigzag ring attention: load-balanced causal sequence parallelism
+# ---------------------------------------------------------------------------
+# Plain causal ring attention is imbalanced: shard r's queries see only the
+# first r+1 of n K/V shards, so at every ring step roughly half the chips
+# hold a fully-masked block and idle at the next ppermute barrier.  The
+# zigzag layout splits the sequence into 2n chunks and gives rank r chunks
+# (r, 2n−1−r) — one early, one late — so every rank does the same
+# (2n+1)·c²-sized triangle of work in total and near-uniform work per step.
+# The flash kernel's dynamic diagonal bound (ops/flash_attention.py) turns
+# the masked half-pairs into ~zero-cost launches.
+
+
+def zigzag_permutation(seq_len: int, n: int):
+    """Global index order that makes contiguous shard r hold zigzag chunks
+    (r, 2n−1−r).  Apply as ``x[:, perm]`` before a P(None, axis) shard."""
+    c, rem = divmod(seq_len, 2 * n)
+    if rem or c == 0:
+        raise ValueError(
+            f"zigzag needs seq_len divisible by 2·n ({seq_len} vs n={n})")
+    idx = []
+    for r in range(n):
+        idx.extend(range(r * c, (r + 1) * c))
+        idx.extend(range((2 * n - 1 - r) * c, (2 * n - r) * c))
+    return np.asarray(idx)
+
+
+def zigzag_inverse_permutation(seq_len: int, n: int):
+    """Inverse of :func:`zigzag_permutation` (restores natural order)."""
+    perm = zigzag_permutation(seq_len, n)
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(seq_len)
+    return inv
+
+
+def zigzag_positions(s_local: int, axis_name: str):
+    """Global sequence positions of this rank's zigzag shard ([s_local]).
+
+    For models with position-dependent layers (RoPE): pass as
+    ``Transformer(..., positions=...)`` so embeddings match the layout.
+    """
+    n = lax.axis_size(axis_name)
+    r = lax.axis_index(axis_name)
+    c = s_local // 2
+    lo = r * c + jnp.arange(c)
+    hi = (2 * n - 1 - r) * c + jnp.arange(c)
+    return jnp.concatenate([lo, hi])
+
+
+def _zigzag_chunks(x, c):
+    return x[:, :c], x[:, c:]
+
+
+def _zigzag_flash_forward(q, k, v, axis_name, causal, block_q, block_k):
+    """Forward zigzag ring pass; returns (out_f32, merged lse), local order
+    [chunk_lo ∥ chunk_hi]."""
+    n = lax.axis_size(axis_name)
+    r = lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+    if s_local % 2:
+        raise ValueError(f"zigzag shard length must be even, got {s_local}")
+    c = s_local // 2
+    from horovod_tpu.ops.flash_attention import flash_attention_with_lse
+
+    varying = functools.partial(lax.pcast, axis_name=axis_name, to="varying")
+    outs = [varying(jnp.zeros((b, c, h, d), jnp.float32)) for _ in range(2)]
+    lses = [varying(jnp.full((b, c, h), NEG_INF, jnp.float32))
+            for _ in range(2)]
+    q_halves = _zigzag_chunks(q, c)
+    q_offs = (r * c, (2 * n - 1 - r) * c)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, i):
+        k, v, out0, lse0, out1, lse1 = carry
+        owner = (r - i) % n
+        k_offs = (owner * c, (2 * n - 1 - owner) * c)
+        k_halves = _zigzag_chunks(k, c)
+        v_halves = _zigzag_chunks(v, c)
+        acc = [[out0, lse0], [out1, lse1]]
+        for qi in range(2):
+            for ki in range(2):
+                o_p, lse_p = flash_attention_with_lse(
+                    q_halves[qi], k_halves[ki], v_halves[ki], causal=causal,
+                    q_offset=q_offs[qi], k_offset=k_offs[ki],
+                    block_q=block_q, block_k=block_k)
+                acc[qi][0], acc[qi][1] = _merge_partial(
+                    acc[qi][0], acc[qi][1], o_p, lse_p)
+        k = lax.ppermute(k, axis_name, perm)
+        v = lax.ppermute(v, axis_name, perm)
+        return (k, v, acc[0][0], acc[0][1], acc[1][0], acc[1][1]), None
+
+    (_, _, out0, lse0, out1, lse1), _ = lax.scan(
+        step, (k, v, outs[0], lses[0], outs[1], lses[1]), jnp.arange(n))
+    return (jnp.concatenate([out0, out1], axis=1),
+            jnp.concatenate([lse0, lse1], axis=1))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def zigzag_ring_flash_attention(q, k, v, axis_name: str, causal: bool = True,
+                                block_q: int = 128, block_k: int = 128):
+    """Load-balanced causal ring attention over zigzag-sharded sequences.
+
+    Inputs are this rank's zigzag shard ([B, 2c, H, D], chunks (r, 2n−1−r)
+    concatenated — see :func:`zigzag_permutation`); output is the matching
+    local shard of the exact attention result.  Numerics are identical to
+    :func:`ring_flash_attention`; only the work distribution changes — with
+    causal masking every rank streams the same number of unmasked K/V
+    blocks, instead of rank n−1 doing n× rank 0's work.
+    """
+    out, _ = _zigzag_flash_forward(q, k, v, axis_name, causal, block_q,
+                                   block_k)
+    return out.astype(q.dtype)
+
+
+def _zigzag_fwd(q, k, v, axis_name, causal, block_q, block_k):
+    out, lse = _zigzag_flash_forward(q, k, v, axis_name, causal, block_q,
+                                     block_k)
+    return out.astype(q.dtype), (q, k, v, out, lse)
+
+
+def _zigzag_bwd(axis_name, causal, block_q, block_k, res, g):
+    from horovod_tpu.ops.flash_attention import flash_attention_backward
+
+    q, k, v, out, lse = res
+    n = lax.axis_size(axis_name)
+    r = lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+    c = s_local // 2
+    delta = jnp.sum(g.astype(jnp.float32) * out, axis=-1)   # [B, 2c, H]
+    interpret = jax.default_backend() != "tpu"
+
+    varying = functools.partial(lax.pcast, axis_name=axis_name, to="varying")
+    half = (b, c, h, d)
+    dqs = [varying(jnp.zeros(half, jnp.float32)) for _ in range(2)]
+    dks = [varying(jnp.zeros(half, jnp.float32)) for _ in range(2)]
+    dvs = [varying(jnp.zeros(half, jnp.float32)) for _ in range(2)]
+    q_halves = _zigzag_chunks(q, c)
+    g_halves = _zigzag_chunks(g, c)
+    lse_halves = _zigzag_chunks(lse, c)
+    delta_halves = _zigzag_chunks(delta, c)
+    q_offs = (r * c, (2 * n - 1 - r) * c)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, i):
+        k, v, dk_halves, dv_halves, dq_halves = carry
+        dk_halves, dv_halves = list(dk_halves), list(dv_halves)
+        dq_halves = list(dq_halves)
+        owner = (r - i) % n
+        k_offs = (owner * c, (2 * n - 1 - owner) * c)
+        k_halves = _zigzag_chunks(k, c)
+        v_halves = _zigzag_chunks(v, c)
+        for qi in range(2):
+            for ki in range(2):
+                dq_p, dk_p, dv_p = flash_attention_backward(
+                    q_halves[qi], k_halves[ki], v_halves[ki], g_halves[qi],
+                    lse_halves[qi], delta_halves[qi], causal,
+                    q_offs[qi], k_offs[ki], block_q, block_k, interpret)
+                dq_halves[qi] = dq_halves[qi] + dq_p.astype(jnp.float32)
+                dk_halves[ki] = dk_halves[ki] + dk_p.astype(jnp.float32)
+                dv_halves[ki] = dv_halves[ki] + dv_p.astype(jnp.float32)
+        # dk/dv travel WITH their K/V blocks: after n rotations both the
+        # blocks and their accumulated gradients are home.
+        rot = functools.partial(lax.ppermute, axis_name=axis_name, perm=perm)
+        return (rot(k), rot(v), tuple(map(rot, dk_halves)),
+                tuple(map(rot, dv_halves)), tuple(dq_halves)), None
+
+    (_, _, dk_halves, dv_halves, dq_halves), _ = lax.scan(
+        step, (k, v, tuple(dks), tuple(dvs), tuple(dqs)), jnp.arange(n))
+    cat = functools.partial(jnp.concatenate, axis=1)
+    return (cat(dq_halves).astype(q.dtype), cat(dk_halves).astype(k.dtype),
+            cat(dv_halves).astype(v.dtype))
+
+
+zigzag_ring_flash_attention.defvjp(_zigzag_fwd, _zigzag_bwd)
+
+
+def make_zigzag_ring_flash_attention(axis_name: str, block_q: int = 128,
+                                     block_k: int = 128):
+    """Adapter producing a ``TransformerConfig.attention_fn`` (pair with
+    ``positions=zigzag_positions(...)`` so RoPE matches the layout)."""
+    return functools.partial(zigzag_ring_flash_attention,
+                             axis_name=axis_name, block_q=block_q,
+                             block_k=block_k)

@@ -53,6 +53,13 @@ def test_jax_longseq_transformer():
     assert "step 0" in out
 
 
+def test_jax_longseq_transformer_zigzag():
+    out = _run("jax_longseq_transformer.py", "--seq-len", "512", "--layers",
+               "1", "--heads", "4", "--embed", "64", "--steps", "1",
+               "--zigzag")
+    assert "step 0" in out
+
+
 @pytest.mark.slow
 def test_jax_imagenet_resnet50(tmp_path):
     out = _run("jax_imagenet_resnet50.py", "--epochs", "1",
