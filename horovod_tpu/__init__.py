@@ -16,65 +16,91 @@ Typical use (JAX, data-parallel — analog of reference README.md:148-226)::
     hvd.init()
     step = hvd.shard(my_step, in_specs=..., out_specs=...)
     # inside my_step: grads = hvd.grouped_allreduce(grads)  # fused psum
+
+The package root resolves its surface lazily (PEP 562): ``import
+horovod_tpu`` costs milliseconds, and the heavy jax import is paid on first
+use of an attribute that needs it.  This matters operationally — engine-only
+consumers (the C++ control-plane tests, torch/TF eager workers before
+``init()``) boot fast, and N freshly spawned ranks don't all pay a
+multi-second jax import just to reach their rendezvous window.
 """
 
-from horovod_tpu.basics import (  # noqa: F401
-    NotInitializedError,
-    chips_per_slice,
-    cross_rank,
-    cross_size,
-    init,
-    is_initialized,
-    local_num_chips,
-    local_rank,
-    local_size,
-    mpi_threads_supported,
-    num_chips,
-    rank,
-    shutdown,
-    size,
-)
-from horovod_tpu.core.engine import CollectiveError  # noqa: F401
-from horovod_tpu.mesh import (  # noqa: F401
-    DATA_AXIS,
-    data_sharding,
-    data_spec,
-    global_mesh,
-    replicated_sharding,
-)
-from horovod_tpu.ops import (  # noqa: F401
-    Compression,
-    allgather,
-    allgather_async,
-    allreduce,
-    allreduce_async,
-    allreduce_sparse,
-    alltoall,
-    alltoall_async,
-    barrier,
-    batch_spec,
-    broadcast,
-    broadcast_async,
-    flash_attention,
-    grouped_allreduce,
-    make_flash_attention,
-    poll,
-    shard,
-    sparse_to_dense,
-    synchronize,
-)
-from horovod_tpu.training import (  # noqa: F401
-    DistributedOptimizer,
-    allgather_object,
-    broadcast_object,
-    broadcast_optimizer_state,
-    broadcast_parameters,
-    scale_learning_rate,
-)
-from horovod_tpu import callbacks  # noqa: F401
-from horovod_tpu import checkpoint  # noqa: F401
-from horovod_tpu import data  # noqa: F401
-from horovod_tpu import parallel  # noqa: F401
-from horovod_tpu.utils import profiling  # noqa: F401
+from __future__ import annotations
+
+import importlib
 
 __version__ = "0.1.0"
+
+# attribute name -> module that defines it.  Submodules (callbacks, data,
+# checkpoint, ...) resolve through importlib directly.
+_ATTR_HOME = {}
+for _mod, _names in {
+    "horovod_tpu.basics": (
+        "NotInitializedError", "chips_per_slice", "cross_rank", "cross_size",
+        "init", "is_initialized", "local_num_chips", "local_rank",
+        "local_size", "mpi_threads_supported", "num_chips", "rank",
+        "shutdown", "size",
+    ),
+    "horovod_tpu.core.engine": ("CollectiveError",),
+    "horovod_tpu.mesh": (
+        "DATA_AXIS", "data_sharding", "data_spec", "global_mesh",
+        "replicated_sharding",
+    ),
+    "horovod_tpu.ops": (
+        "Compression", "allgather", "allgather_async", "allreduce",
+        "allreduce_async", "allreduce_sparse", "alltoall", "alltoall_async",
+        "barrier", "batch_spec", "broadcast", "broadcast_async",
+        "flash_attention", "grouped_allreduce", "make_flash_attention",
+        "poll", "shard", "sparse_to_dense", "synchronize",
+    ),
+    "horovod_tpu.training": (
+        "DistributedOptimizer", "allgather_object", "broadcast_object",
+        "broadcast_optimizer_state", "broadcast_parameters",
+        "scale_learning_rate",
+    ),
+}.items():
+    for _n in _names:
+        _ATTR_HOME[_n] = _mod
+del _mod, _names, _n
+
+# Attributes that resolve to a module rather than a symbol inside one.
+_MODULE_ATTRS = {"profiling": "horovod_tpu.utils.profiling"}
+
+_SUBMODULES = frozenset({
+    "basics", "callbacks", "checkpoint", "core", "data", "flax", "keras",
+    "mesh", "models", "ops", "parallel", "run", "tensorflow", "torch",
+    "training", "utils",
+})
+
+# NOTE: __all__ deliberately excludes the lazy submodules — a star-import
+# must not eagerly pull in every optional framework binding (torch/TF may
+# not even be installed where the jax path runs).
+__all__ = sorted(_ATTR_HOME) + ["__version__"]
+
+
+def __getattr__(name: str):
+    home = _ATTR_HOME.get(name)
+    if home is not None:
+        value = getattr(importlib.import_module(home), name)
+    elif name in _MODULE_ATTRS:
+        value = importlib.import_module(_MODULE_ATTRS[name])
+    elif name in _SUBMODULES:
+        try:
+            value = importlib.import_module(f"horovod_tpu.{name}")
+        except ModuleNotFoundError as e:
+            # An optional framework (torch/TF) missing from the environment
+            # must read as "attribute absent" so hasattr()/getattr(default)
+            # probing keeps working; a missing module *inside* horovod_tpu
+            # is a real bug and propagates.
+            if e.name is not None and e.name.startswith("horovod_tpu"):
+                raise
+            raise AttributeError(
+                f"horovod_tpu.{name} is unavailable: {e}") from e
+    else:
+        raise AttributeError(f"module 'horovod_tpu' has no attribute {name!r}")
+    globals()[name] = value  # cache: __getattr__ runs once per name
+    return value
+
+
+def __dir__():
+    return sorted(set(__all__) | _SUBMODULES | set(_MODULE_ATTRS))

@@ -32,7 +32,10 @@ import dataclasses
 import os
 import threading
 
-import jax
+# jax is imported inside the functions that need it: the package root
+# resolves lazily (see __init__.py) so that engine-only consumers and
+# freshly spawned worker ranks don't pay the jax import before their
+# control-plane rendezvous.
 
 
 class NotInitializedError(RuntimeError):
@@ -76,6 +79,8 @@ def _detect_slices(devices) -> tuple[int, int]:
     (operations.cc:1499-1532) with "slice" standing in for "node": ICI links
     chips within a slice, DCN links slices.
     """
+    import jax
+
     slice_ids = sorted({getattr(d, "slice_index", 0) for d in devices})
     local = jax.local_devices()
     my_slice = getattr(local[0], "slice_index", 0) if local else 0
@@ -101,6 +106,8 @@ def init(*, distributed: bool | None = None, coordinator_address: str | None = N
     ``InitializeHorovodOnce`` (reference operations.cc:1907-1925).
     """
     global _topology
+    import jax
+
     with _lock:
         if _topology is not None:
             return

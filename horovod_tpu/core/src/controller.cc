@@ -1,10 +1,16 @@
 #include "controller.h"
 
 #include <arpa/inet.h>
+#include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
 #include <sys/socket.h>
+#include <sys/time.h>
 #include <unistd.h>
+
+#include <chrono>
+#include <cstdlib>
 
 #include <algorithm>
 #include <cerrno>
@@ -56,6 +62,19 @@ bool RecvFrame(int fd, std::string* payload) {
   return len == 0 || RecvAll(fd, payload->data(), len);
 }
 
+// Rendezvous budget, seconds.  Peers can lag the whole interpreter-boot
+// cost behind each other (importing jax in a fresh child takes tens of
+// seconds on a small loaded host), so both the worker's connect retry and
+// the coordinator's accept wait share one generous, overridable deadline.
+double RendezvousBudgetSeconds() {
+  const char* v = ::getenv("HVD_TPU_CONNECT_TIMEOUT");
+  if (v != nullptr && *v != '\0') {
+    double d = ::atof(v);
+    if (d > 0) return d;
+  }
+  return 300.0;
+}
+
 }  // namespace
 
 // ---------------------------------------------------------------------------
@@ -87,16 +106,66 @@ std::unique_ptr<TcpControlPlane> TcpControlPlane::MakeCoordinator(
   ::getsockname(cp->listen_fd_, reinterpret_cast<sockaddr*>(&addr), &alen);
   cp->port_ = ntohs(addr.sin_port);
   cp->worker_fds_.assign(static_cast<size_t>(size > 0 ? size - 1 : 0), -1);
+  // Bounded accept: a worker that died pre-connect must surface as an error
+  // here, not hang the coordinator forever (the silent-hang analog of the
+  // reference's stall contract).  The listen fd is non-blocking because a
+  // peer can connect and RST between poll() and accept(), in which case
+  // Linux drops it from the queue and a blocking accept() would hang.
+  int fl = ::fcntl(cp->listen_fd_, F_GETFL, 0);
+  ::fcntl(cp->listen_fd_, F_SETFL, fl | O_NONBLOCK);
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::duration<double>(RendezvousBudgetSeconds());
   for (int i = 0; i < size - 1; ++i) {
-    int fd = ::accept(cp->listen_fd_, nullptr, nullptr);
-    if (fd < 0) {
+    pollfd pfd{cp->listen_fd_, POLLIN, 0};
+    int fd = -1;
+    for (;;) {
+      auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+          deadline - std::chrono::steady_clock::now());
+      if (left.count() <= 0) {
+        *err = "rendezvous timed out: " + std::to_string(i) + "/" +
+               std::to_string(size - 1) +
+               " workers connected (HVD_TPU_CONNECT_TIMEOUT to extend)";
+        return nullptr;
+      }
+      int pr = ::poll(&pfd, 1,
+                      static_cast<int>(std::min<long long>(left.count(),
+                                                           1000)));
+      if (pr < 0 && errno != EINTR) {
+        *err = "poll() failed";
+        return nullptr;
+      }
+      if (pr <= 0 || !(pfd.revents & POLLIN)) continue;
+      fd = ::accept(cp->listen_fd_, nullptr, nullptr);
+      if (fd >= 0) break;
+      if (errno == EAGAIN || errno == EWOULDBLOCK || errno == ECONNABORTED ||
+          errno == EINTR) {
+        continue;  // aborted mid-handshake: keep waiting for a real peer
+      }
       *err = "accept() failed";
       return nullptr;
     }
     ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    // Bound the hello read by the remaining budget too: a peer that
+    // connects but never speaks must not hang the quorum.
+    auto hello_left = std::chrono::duration_cast<std::chrono::milliseconds>(
+        deadline - std::chrono::steady_clock::now());
+    if (hello_left.count() <= 0) {
+      // SO_RCVTIMEO of zero would mean "no timeout" — fail instead.
+      ::close(fd);
+      *err = "rendezvous timed out awaiting hello (HVD_TPU_CONNECT_TIMEOUT "
+             "to extend)";
+      return nullptr;
+    }
+    timeval tv{};
+    tv.tv_sec = static_cast<time_t>(hello_left.count() / 1000);
+    tv.tv_usec = static_cast<suseconds_t>((hello_left.count() % 1000) * 1000);
+    ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
     std::string hello;
     int32_t rank = -1;
-    if (!RecvFrame(fd, &hello) || hello.size() != 4) {
+    bool hello_ok = RecvFrame(fd, &hello) && hello.size() == 4;
+    timeval zero{};
+    ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &zero, sizeof(zero));
+    if (!hello_ok) {
       *err = "bad hello";
       return nullptr;
     }
@@ -114,13 +183,7 @@ std::unique_ptr<TcpControlPlane> TcpControlPlane::MakeWorker(
     const std::string& host, int port, int rank, std::string* err) {
   std::unique_ptr<TcpControlPlane> cp(new TcpControlPlane());
   cp->coordinator_ = false;
-  cp->sock_ = ::socket(AF_INET, SOCK_STREAM, 0);
-  if (cp->sock_ < 0) {
-    *err = "socket() failed";
-    return nullptr;
-  }
   int one = 1;
-  ::setsockopt(cp->sock_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_port = htons(static_cast<uint16_t>(port));
@@ -128,14 +191,28 @@ std::unique_ptr<TcpControlPlane> TcpControlPlane::MakeWorker(
     *err = "bad coordinator address " + host;
     return nullptr;
   }
-  // The coordinator may come up after workers; retry for ~30 s.
-  for (int attempt = 0;; ++attempt) {
+  // The coordinator may come up long after workers (each peer pays the full
+  // interpreter/jax boot cost independently); retry on a fresh socket each
+  // attempt (POSIX: a socket is unusable after a failed connect) until the
+  // shared rendezvous budget runs out.
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::duration<double>(RendezvousBudgetSeconds());
+  for (;;) {
+    cp->sock_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (cp->sock_ < 0) {
+      *err = "socket() failed";
+      return nullptr;
+    }
+    ::setsockopt(cp->sock_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
     if (::connect(cp->sock_, reinterpret_cast<sockaddr*>(&addr),
                   sizeof(addr)) == 0) {
       break;
     }
-    if (attempt > 300) {
-      *err = "connect to " + host + ":" + std::to_string(port) + " failed";
+    ::close(cp->sock_);
+    cp->sock_ = -1;
+    if (std::chrono::steady_clock::now() >= deadline) {
+      *err = "connect to " + host + ":" + std::to_string(port) +
+             " failed (HVD_TPU_CONNECT_TIMEOUT to extend)";
       return nullptr;
     }
     ::usleep(100 * 1000);

@@ -1,0 +1,197 @@
+"""``python -m horovod_tpu.run`` — the launcher, analog of ``mpirun -np N``.
+
+The reference has no launcher code in-tree: users invoke
+``mpirun -np 4 -H host1:2,host2:2 python train.py`` and MPI wires ranks
+together (reference README.md:148-180, docs/running.md).  On TPU pods the
+managed runtime plays that role (one process per host, topology from env
+— see docs/running.md), so this launcher exists for the remaining case the
+reference covered with ``mpirun`` on a single box: N cooperating local
+processes.  That is how the eager/torch/TF control plane is exercised
+without a pod — and how the reference's own CI ran its whole test suite
+(``mpirun -np 2``, reference .travis.yml:102-111).
+
+What it does for each of the N ranks:
+
+* assigns ``JAX_PROCESS_ID``/``JAX_NUM_PROCESSES``/``JAX_COORDINATOR_ADDRESS``
+  so ``hvd.init()`` forms the jax.distributed cluster (basics.py:109-130);
+* points every rank at rank 0's TCP control plane via
+  ``HVD_TPU_COORDINATOR_HOST``/``_PORT`` (core/src/controller.cc);
+* selects the multihost data plane (``HVD_TPU_EXECUTOR=multihost``) unless
+  the caller pinned one;
+* tags each line of child output with ``[rank]:`` (mpirun's
+  ``--tag-output``), and on the first abnormal child exit terminates the
+  remaining ranks and exits with that rank's code — matching mpirun's
+  job-abort contract so a crashed rank can never leave the job hung.
+
+Multi-host dispatch (``-H host1:2,...``) is intentionally not implemented:
+TPU pods launch per-host processes through the pod runtime, not ssh; the
+error message points at docs/running.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+_TERM_GRACE_SECONDS = 5.0
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _pump(stream, rank: int, tag: bool, lock: threading.Lock) -> None:
+    """Forward a child's merged output line-by-line, optionally tagged."""
+    prefix = f"[{rank}]: " if tag else ""
+    for line in iter(stream.readline, b""):
+        text = line.decode("utf-8", "replace")
+        with lock:
+            sys.stdout.write(prefix + text)
+            sys.stdout.flush()
+    stream.close()
+
+
+def _child_env(rank: int, np_: int, jax_port: int, coord_port: int,
+               platform: str | None) -> dict[str, str]:
+    env = dict(os.environ)
+    env["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{jax_port}"
+    env["JAX_NUM_PROCESSES"] = str(np_)
+    env["JAX_PROCESS_ID"] = str(rank)
+    env["HVD_TPU_COORDINATOR_HOST"] = "127.0.0.1"
+    env["HVD_TPU_COORDINATOR_PORT"] = str(coord_port)
+    env.setdefault("HVD_TPU_EXECUTOR", "multihost")
+    if platform:
+        env["JAX_PLATFORMS"] = platform
+        if platform == "cpu":
+            # One virtual CPU device per process — N processes × 1 device is
+            # the mpirun-style topology; strip any inherited TPU-tunnel
+            # bootstrap so children come up as plain CPU interpreters.
+            flags = env.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                env["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count=1")
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            env["PYTHONPATH"] = ":".join(
+                p for p in env.get("PYTHONPATH", "").split(":")
+                if p and ".axon_site" not in p)
+    return env
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.run",
+        description="Launch N cooperating horovod_tpu processes on this host "
+                    "(the mpirun -np analog; see docs/running.md).")
+    parser.add_argument("-np", "--num-proc", type=int, required=True,
+                        dest="np_", metavar="N",
+                        help="number of processes to launch")
+    parser.add_argument("-H", "--hosts", default=None,
+                        help="not supported: TPU pods launch per-host "
+                             "processes via the pod runtime (docs/running.md)")
+    parser.add_argument("--platform", default="cpu",
+                        help="JAX_PLATFORMS for children (default: cpu — N "
+                             "local processes cannot share one TPU chip; "
+                             "pass '' to inherit the parent's platform)")
+    parser.add_argument("--no-tag-output", action="store_true",
+                        help="do not prefix child output with '[rank]: '")
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="program and arguments (e.g. python train.py)")
+    args = parser.parse_args(argv)
+
+    if args.hosts is not None:
+        parser.error("-H/--hosts is not supported: multi-host TPU jobs are "
+                     "launched by the pod runtime, one process per host "
+                     "(docs/running.md 'Multi-host TPU pod slice')")
+    if args.np_ < 1:
+        parser.error("-np must be >= 1")
+    command = args.command
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        parser.error("no command given (e.g. ... -np 2 python train.py)")
+
+    jax_port, coord_port = _free_port(), _free_port()
+    lock = threading.Lock()
+    procs: list[subprocess.Popen] = []
+    pumps: list[threading.Thread] = []
+    try:
+        for rank in range(args.np_):
+            p = subprocess.Popen(
+                command,
+                env=_child_env(rank, args.np_, jax_port, coord_port,
+                               args.platform or None),
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+            procs.append(p)
+            t = threading.Thread(target=_pump,
+                                 args=(p.stdout, rank,
+                                       not args.no_tag_output, lock),
+                                 daemon=True)
+            t.start()
+            pumps.append(t)
+    except BaseException:
+        # A failed spawn (fork EAGAIN, bad command) must not leak the ranks
+        # already started — they'd sit in the rendezvous for its full budget.
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        raise
+
+    def _abort(signum, frame):  # forward Ctrl-C / SIGTERM to the whole job
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+
+    signal.signal(signal.SIGINT, _abort)
+    signal.signal(signal.SIGTERM, _abort)
+
+    # mpirun contract: first abnormal exit aborts the job.  Poll until every
+    # rank finishes or one fails; on failure, give the rest a grace period
+    # then kill.
+    exit_code = 0
+    remaining = set(range(args.np_))
+    try:
+        while remaining:
+            done = [r for r in remaining if procs[r].poll() is not None]
+            if not done:
+                time.sleep(0.05)
+                continue
+            for r in done:
+                remaining.discard(r)
+                rc = procs[r].returncode
+                if rc < 0:  # killed by signal: report as 128+signum
+                    rc = 128 - rc
+                if rc != 0 and exit_code == 0:
+                    exit_code = rc
+                    with lock:
+                        sys.stderr.write(
+                            f"horovod_tpu.run: rank {r} exited with code "
+                            f"{rc}; terminating remaining ranks\n")
+                    for other in remaining:
+                        if procs[other].poll() is None:
+                            procs[other].terminate()
+                    for other in remaining:
+                        try:
+                            procs[other].wait(timeout=_TERM_GRACE_SECONDS)
+                        except subprocess.TimeoutExpired:
+                            procs[other].kill()
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for t in pumps:
+            t.join(timeout=2.0)
+    return exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

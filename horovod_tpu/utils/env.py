@@ -18,6 +18,10 @@ aliases; the TPU-specific defaults differ where the hardware does:
 * ``HOROVOD_HIERARCHICAL_ALLREDUCE`` — two-level reduction; on TPU this means
   intra-slice ICI reduce-scatter + inter-slice DCN allreduce + ICI all-gather
   (reference operations.cc:1025-1177 did NCCL-intra + MPI-inter).
+* ``HVD_TPU_CONNECT_TIMEOUT`` — control-plane rendezvous budget in seconds
+  (default 300; read in core/src/controller.cc): both the worker connect
+  retry and the coordinator accept quorum share it, so a dead peer becomes
+  an error on every rank instead of a hang.
 """
 
 from __future__ import annotations

@@ -1,0 +1,24 @@
+"""Machine-scaled timeouts for multi-process tests.
+
+Every multi-process test boots several child interpreters that each pay the
+full jax-import + backend-init cost (~10 s on an idle many-core box, well
+over a minute when 3-4 children compete for 2 cores mid-suite).  A fixed
+timeout tuned on one machine therefore flakes on another — the round-2
+full-suite run saw 8 pure-timeout failures on a 2-core host whose tests all
+pass in isolation.  Scale wall-clock allowances by the host's parallelism
+instead; override with ``HVD_TEST_TIMEOUT_SCALE``.
+"""
+
+import os
+
+_env = os.environ.get("HVD_TEST_TIMEOUT_SCALE")
+if _env:
+    SCALE = float(_env)
+else:
+    cpus = os.cpu_count() or 1
+    SCALE = 4.0 if cpus <= 2 else (2.0 if cpus <= 4 else 1.0)
+
+
+def scaled(seconds: float) -> float:
+    """Return ``seconds`` scaled for this machine."""
+    return seconds * SCALE

@@ -13,6 +13,16 @@ os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 )
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Multi-process tests spawn child interpreters (multiprocessing.spawn and
+# subprocess workers) that inherit this environment.  The image's TPU-tunnel
+# sitecustomize (on PYTHONPATH) would make every child contact the tunnel
+# relay at interpreter startup; with concurrent children the serialized
+# relay claim can deadlock against the tests' own rendezvous.  The suite is
+# CPU-only — strip the hook so children boot as plain CPU interpreters.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["PYTHONPATH"] = ":".join(
+    p for p in os.environ.get("PYTHONPATH", "").split(":")
+    if p and ".axon_site" not in p)
 
 import jax  # noqa: E402
 

@@ -28,6 +28,8 @@ from horovod_tpu.core.engine import (
 )
 from horovod_tpu.core.executors import local_executor
 
+from _timing import scaled
+
 
 @pytest.fixture()
 def engine():
@@ -174,7 +176,7 @@ def _worker_ok(rank, size, port, q):
         for i in range(5):
             h = eng.enqueue(f"t{i}", np.full(8, rank, np.float32),
                             OP_ALLREDUCE)
-            outs.append(eng.synchronize(h, timeout_s=30))
+            outs.append(eng.synchronize(h, timeout_s=scaled(30)))
         eng.shutdown()
         q.put(("ok", rank, [float(o[0]) for o in outs]))
     except Exception as e:  # noqa: BLE001
@@ -191,7 +193,7 @@ def _worker_mismatch(rank, size, port, q):
         x = np.ones(4 + rank, np.float32)
         h = eng.enqueue("bad", x, OP_ALLREDUCE)
         try:
-            eng.synchronize(h, timeout_s=30)
+            eng.synchronize(h, timeout_s=scaled(30))
             q.put(("no-error", rank, None))
         except CollectiveError as e:
             q.put(("collective-error", rank, str(e)))
@@ -200,22 +202,41 @@ def _worker_mismatch(rank, size, port, q):
         q.put(("err", rank, repr(e)))
 
 
+def _run_spawn(fn, nprocs=2):
+    """Spawn ``nprocs`` workers and collect one queue message from each.
+
+    Children are ALWAYS reaped — including on the q.get timeout path.  (A
+    bare list-comprehension followed by joins leaked live children whenever
+    the timeout fired first, and a wedged orphan then poisoned every later
+    multi-process test in the session.)
+    """
+    ctx = multiprocessing.get_context("spawn")
+    port = _free_port()
+    q = ctx.Queue()
+    procs = [ctx.Process(target=fn, args=(r, nprocs, port, q))
+             for r in range(nprocs)]
+    for p in procs:
+        p.start()
+    ok = False
+    try:
+        results = [q.get(timeout=scaled(60)) for _ in procs]
+        ok = True
+        return results
+    finally:
+        for p in procs:
+            if ok:
+                p.join(timeout=scaled(30))
+            if p.is_alive():
+                p.kill()
+                p.join(timeout=10)
+
+
 @pytest.mark.parametrize("fn,expect", [
     (_worker_ok, "ok"),
     (_worker_mismatch, "collective-error"),
 ])
 def test_two_process_tcp(fn, expect):
-    ctx = multiprocessing.get_context("spawn")
-    port = _free_port()
-    q = ctx.Queue()
-    procs = [ctx.Process(target=fn, args=(r, 2, port, q)) for r in range(2)]
-    for p in procs:
-        p.start()
-    results = [q.get(timeout=60) for _ in procs]
-    for p in procs:
-        p.join(timeout=30)
-        if p.is_alive():
-            p.terminate()
+    results = _run_spawn(fn)
     kinds = {r[0] for r in results}
     assert kinds == {expect}, results
     if expect == "collective-error":
@@ -237,7 +258,7 @@ def _worker_peer_death(rank, size, port, q):
             os._exit(1)
         h = eng.enqueue("orphan", np.ones(4, np.float32), OP_ALLREDUCE)
         try:
-            eng.synchronize(h, timeout_s=30)
+            eng.synchronize(h, timeout_s=scaled(30))
             q.put(("completed", rank, None))
         except Exception as e:  # noqa: BLE001
             q.put(("aborted", rank, type(e).__name__ + ": " + str(e)[:120]))
@@ -254,7 +275,7 @@ def _worker_dtype_mismatch(rank, size, port, q):
         x = np.ones(4, np.float32 if rank == 0 else np.float64)
         h = eng.enqueue("badtype", x, OP_ALLREDUCE)
         try:
-            eng.synchronize(h, timeout_s=30)
+            eng.synchronize(h, timeout_s=scaled(30))
             q.put(("no-error", rank, None))
         except CollectiveError as e:
             q.put(("collective-error", rank, str(e)))
@@ -269,9 +290,9 @@ def _worker_root_mismatch(rank, size, port, q):
                            coordinator_host="127.0.0.1",
                            coordinator_port=port, cycle_time_ms=2.0)
         h = eng.enqueue("badroot", np.ones(2, np.float32), OP_BROADCAST,
-                        root_rank=rank)  #每 rank different root
+                        root_rank=rank)  # every rank names a different root
         try:
-            eng.synchronize(h, timeout_s=30)
+            eng.synchronize(h, timeout_s=scaled(30))
             q.put(("no-error", rank, None))
         except CollectiveError as e:
             q.put(("collective-error", rank, str(e)))
@@ -283,18 +304,7 @@ def _worker_root_mismatch(rank, size, port, q):
 def test_peer_death_aborts_instead_of_hanging():
     """A crashed rank must fail the survivors' pending work, not hang them
     (reference SHUT_DOWN_ERROR / transport-failure path)."""
-    ctx = multiprocessing.get_context("spawn")
-    port = _free_port()
-    q = ctx.Queue()
-    procs = [ctx.Process(target=_worker_peer_death, args=(r, 2, port, q))
-             for r in range(2)]
-    for p in procs:
-        p.start()
-    results = [q.get(timeout=60) for _ in procs]
-    for p in procs:
-        p.join(timeout=30)
-        if p.is_alive():
-            p.terminate()
+    results = _run_spawn(_worker_peer_death)
     kinds = sorted(r[0] for r in results)
     assert kinds == ["aborted", "died"], results
 
@@ -304,16 +314,6 @@ def test_peer_death_aborts_instead_of_hanging():
     (_worker_root_mismatch, "Mismatched root ranks"),
 ])
 def test_mismatch_error_propagation(fn, needle):
-    ctx = multiprocessing.get_context("spawn")
-    port = _free_port()
-    q = ctx.Queue()
-    procs = [ctx.Process(target=fn, args=(r, 2, port, q)) for r in range(2)]
-    for p in procs:
-        p.start()
-    results = [q.get(timeout=60) for _ in procs]
-    for p in procs:
-        p.join(timeout=30)
-        if p.is_alive():
-            p.terminate()
+    results = _run_spawn(fn)
     assert {r[0] for r in results} == {"collective-error"}, results
     assert all(needle in r[2] for r in results), results

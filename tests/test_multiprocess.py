@@ -21,6 +21,8 @@ import textwrap
 
 import pytest
 
+from _timing import scaled
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TSAN_RUNTIME = "/lib/x86_64-linux-gnu/libtsan.so.2"
 
@@ -245,7 +247,7 @@ def _run_workers_once(script, nprocs, timeout, extra_env):
     return outs
 
 
-def _run_workers(script, nprocs, timeout=240, extra_env=None):
+def _run_workers(script, nprocs, timeout=scaled(240), extra_env=None):
     outs = _run_workers_once(script, nprocs, timeout, extra_env)
     if not all(f"RANK{r} OK" in out for r, (out, _) in enumerate(outs)):
         # Retry ONCE only on infrastructure noise (gloo/coordination
@@ -298,7 +300,7 @@ TSAN_WORKER = textwrap.dedent("""
         for i in range(40):
             h = eng.enqueue(f"t{tid}.{i}", np.full(64, rank, np.float32),
                             OP_ALLREDUCE)
-            eng.synchronize(h, timeout_s=60)
+            eng.synchronize(h, timeout_s=scaled(60))
 
     threads = [threading.Thread(target=pound, args=(t,)) for t in range(4)]
     for t in threads: t.start()
@@ -309,19 +311,19 @@ TSAN_WORKER = textwrap.dedent("""
     for i in range(10):
         eng.synchronize(eng.enqueue(f"g{i}", np.ones((rank + 1, 2),
                                                      np.float32),
-                                    OP_ALLGATHER), timeout_s=60)
+                                    OP_ALLGATHER), timeout_s=scaled(60))
         eng.synchronize(eng.enqueue(f"b{i}", np.ones(4, np.float32),
-                                    OP_BROADCAST, root_rank=0), timeout_s=60)
+                                    OP_BROADCAST, root_rank=0), timeout_s=scaled(60))
         eng.synchronize(eng.enqueue(f"bar{i}", np.zeros(1, np.uint8),
-                                    OP_BARRIER), timeout_s=60)
+                                    OP_BARRIER), timeout_s=scaled(60))
     try:
         eng.synchronize(eng.enqueue("bad", np.ones(4 + rank, np.float32),
-                                    OP_ALLREDUCE), timeout_s=60)
+                                    OP_ALLREDUCE), timeout_s=scaled(60))
     except CollectiveError:
         pass
     eng.shutdown()
     print(f"RANK{rank} OK", flush=True)
-""")
+""").replace("scaled(60)", repr(scaled(60)))  # children don't import _timing
 
 
 @pytest.mark.parametrize("nprocs", [2, 4])
@@ -339,7 +341,7 @@ def test_engine_under_tsan(nprocs):
     if not os.path.exists(TSAN_RUNTIME):
         pytest.skip("libtsan runtime not installed")
     outs = _run_workers(
-        TSAN_WORKER, nprocs, timeout=360,
+        TSAN_WORKER, nprocs, timeout=scaled(360),
         extra_env={"HVD_CORE_LIB": "libhvdcore_tsan.so",
                    "LD_PRELOAD": TSAN_RUNTIME,
                    "TSAN_OPTIONS": "report_bugs=1 halt_on_error=0 "

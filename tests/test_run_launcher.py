@@ -1,0 +1,67 @@
+"""The ``python -m horovod_tpu.run`` launcher (mpirun -np analog).
+
+Covers the two contracts mpirun gives the reference's users (reference
+README.md:148-180): (1) N ranks come up wired together — a cross-process
+eager allreduce produces the job-wide sum on every rank; (2) the first
+abnormal rank exit aborts the whole job with that exit code instead of
+leaving surviving ranks hung.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+from _timing import scaled
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+OK_SCRIPT = textwrap.dedent("""
+    import numpy as np
+    import horovod_tpu as hvd
+    hvd.init()
+    h = hvd.allreduce_async(np.full(3, float(hvd.rank() + 1), np.float32),
+                            average=False, name="launch.ar")
+    out = hvd.synchronize(h)
+    expect = hvd.size() * (hvd.size() + 1) / 2
+    np.testing.assert_allclose(out, np.full(3, expect))
+    print(f"RANK{hvd.rank()} SUM={out[0]:.0f}", flush=True)
+""")
+
+CRASH_SCRIPT = textwrap.dedent("""
+    import sys, time
+    import horovod_tpu as hvd
+    hvd.init()
+    if hvd.rank() == 1:
+        sys.exit(7)
+    time.sleep(120)   # must be terminated by the launcher, not run out
+""")
+
+
+def _launch(np_, script, timeout):
+    return subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", str(np_),
+         sys.executable, "-c", script],
+        cwd=REPO, capture_output=True, text=True, timeout=timeout)
+
+
+def test_two_ranks_allreduce_with_tagged_output():
+    res = _launch(2, OK_SCRIPT, timeout=scaled(180))
+    assert res.returncode == 0, res.stdout + res.stderr
+    for rank in (0, 1):
+        assert f"[{rank}]: RANK{rank} SUM=3" in res.stdout, res.stdout
+
+
+def test_crashed_rank_aborts_job_with_its_exit_code():
+    res = _launch(2, CRASH_SCRIPT, timeout=scaled(180))
+    assert res.returncode == 7, res.stdout + res.stderr
+    assert "rank 1 exited with code 7" in res.stderr
+
+
+def test_rejects_hosts_flag():
+    res = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "2", "-H",
+         "a:1,b:1", "true"],
+        cwd=REPO, capture_output=True, text=True, timeout=scaled(60))
+    assert res.returncode != 0
+    assert "pod runtime" in res.stderr

@@ -7,6 +7,8 @@ import subprocess
 import sys
 import textwrap
 
+from _timing import scaled
+
 
 def _free_port() -> int:
     s = socket.socket()
@@ -48,7 +50,12 @@ def test_stall_warning():
                              os.path.dirname(os.path.abspath(__file__))))
         for r in range(2)
     ]
-    outs = [p.communicate(timeout=60) for p in procs]
+    try:
+        outs = [p.communicate(timeout=scaled(60)) for p in procs]
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        raise
     assert "ALIVE" in outs[0][0]
     assert "ALIVE" in outs[1][0]
     stderr0 = outs[0][1]
