@@ -14,7 +14,9 @@ JAX, at both granularities:
 
 from __future__ import annotations
 
-from typing import Iterator, Sequence
+import queue
+import threading
+from typing import Any, Callable, Iterable, Iterator, Sequence
 
 import numpy as np
 
@@ -73,3 +75,115 @@ class ShardedBatches:
         for lo in range(0, n - self.batch + 1, self.batch):
             sel = idx[lo:lo + self.batch]
             yield tuple(np.asarray(a)[sel] for a in self.arrays)
+
+
+class BackgroundLoader:
+    """Run a batch producer on a daemon thread behind a bounded queue.
+
+    The reference delegated loading to framework DataLoaders whose worker
+    processes overlapped IO with compute; on TPU the analog is simply
+    keeping the host's Python loop out of the device's way.  Wraps any
+    iterable (e.g. :class:`ShardedBatches`, or a generator doing real IO /
+    augmentation): production runs ahead of consumption up to ``depth``
+    batches, so host-side loading overlaps device steps.
+
+    A producer exception is re-raised on the consumer thread at the point
+    of ``next()`` — never swallowed.  Iterating again restarts the source
+    (a new epoch for ``ShardedBatches``).
+    """
+
+    _DONE = object()
+
+    def __init__(self, source: Iterable, depth: int = 2):
+        if depth < 1:
+            raise ValueError("depth must be >= 1")
+        self._source = source
+        self._depth = depth
+
+    def __len__(self) -> int:
+        return len(self._source)  # type: ignore[arg-type]
+
+    def __iter__(self) -> Iterator:
+        q: queue.Queue = queue.Queue(maxsize=self._depth)
+        stop = threading.Event()
+
+        def put_or_stop(item) -> bool:
+            # Every producer put honors the stop event — including the
+            # terminal DONE/exception ones, or an abandoning consumer with
+            # a full queue would strand this thread (and its queued
+            # batches) forever.
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def produce() -> None:
+            try:
+                for item in self._source:
+                    if not put_or_stop(item):
+                        return
+                put_or_stop(self._DONE)
+            except BaseException as e:  # noqa: BLE001 — relayed to consumer
+                put_or_stop(e)
+
+        t = threading.Thread(target=produce, name="hvd-loader", daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is self._DONE:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+
+
+def prefetch_to_device(iterator: Iterable, size: int = 2,
+                       sharding: Any = None,
+                       device_put: Callable | None = None) -> Iterator:
+    """Double-buffer host batches onto the device(s).
+
+    Eagerly issues ``jax.device_put`` for up to ``size`` upcoming batches
+    before yielding the current one, so the host-to-device transfer of
+    batch N+1 rides under the compute of batch N (the reference relied on
+    framework loaders + CUDA streams for the same overlap; XLA's async
+    dispatch gives it to us once the puts are issued early).
+
+    ``sharding`` may be a ``jax.sharding.Sharding`` (e.g. the result of
+    ``hvd.data_sharding(ndim)``) applied to every leaf, or a pytree of
+    shardings matching the batch structure.  Without it, leaves land on
+    the default device and the jitted step's in_specs perform the split.
+
+    .. warning:: pass ``sharding`` on real TPU runs only.  On the CPU
+       *simulation* backend (``--xla_force_host_platform_device_count``),
+       multi-device transfer programs interleaved with a compiled step's
+       collectives can starve XLA's in-process collective rendezvous
+       past its hard abort (rendezvous.cc termination timeout) — observed
+       as "Expected N threads to join the rendezvous, but only N-1
+       arrived".  The default (single-device put, resharded by the step)
+       is stable everywhere.
+    """
+    import jax
+
+    put = device_put or jax.device_put
+    buf: list = []
+    it = iter(iterator)
+
+    def enqueue(n: int) -> None:
+        for _ in range(n):
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+            buf.append(put(batch, sharding) if sharding is not None
+                       else put(batch))
+
+    enqueue(size)
+    while buf:
+        yield buf.pop(0)
+        enqueue(1)

@@ -11,6 +11,11 @@ import os
 
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    # The CPU backend hard-aborts the process if a collective participant
+    # lags 40 s (rendezvous.cc termination timeout); on a small CI host 8
+    # virtual devices can exceed that while another program compiles.
+    " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
+    " --xla_cpu_collective_call_terminate_timeout_seconds=600"
 )
 os.environ["JAX_PLATFORMS"] = "cpu"
 # Multi-process tests spawn child interpreters (multiprocessing.spawn and

@@ -1,9 +1,15 @@
 """Data-sharding helper tests (DistributedSampler contract,
-reference README.md:218-219)."""
+reference README.md:218-219) plus the overlap machinery
+(BackgroundLoader, prefetch_to_device)."""
+
+import threading
+import time
 
 import numpy as np
+import pytest
 
-from horovod_tpu.data import ShardedBatches, shard_arrays
+from horovod_tpu.data import (BackgroundLoader, ShardedBatches,
+                              prefetch_to_device, shard_arrays)
 
 
 def test_shard_arrays_single_process(hvd):
@@ -41,3 +47,86 @@ def test_sharded_batches_shuffle_deterministic(hvd):
     e1 = np.concatenate([b[0] for b in s])
     e2 = np.concatenate([b[0] for b in s])
     assert not np.array_equal(e1, e2)
+
+
+def test_background_loader_preserves_order_and_restarts(hvd):
+    src = [np.full(2, i) for i in range(6)]
+    loader = BackgroundLoader(src, depth=2)
+    for _ in range(2):  # re-iterating restarts the source
+        got = list(loader)
+        assert len(got) == 6
+        for i, b in enumerate(got):
+            np.testing.assert_array_equal(b, np.full(2, i))
+
+
+def test_background_loader_overlaps_production(hvd):
+    """Production must run ahead of consumption: with depth 3 and a slow
+    consumer, the producer should be >1 batch ahead while we hold batch 0."""
+    produced = []
+
+    def gen():
+        for i in range(5):
+            produced.append(i)
+            yield i
+
+    it = iter(BackgroundLoader(gen(), depth=3))
+    first = next(it)
+    deadline = time.monotonic() + 5.0
+    while len(produced) < 4 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert first == 0
+    assert len(produced) >= 4, produced  # ran ahead without being asked
+    assert list(it) == [1, 2, 3, 4]
+
+
+def test_background_loader_relays_producer_exception(hvd):
+    def gen():
+        yield 1
+        raise RuntimeError("disk on fire")
+
+    it = iter(BackgroundLoader(gen(), depth=2))
+    assert next(it) == 1
+    with pytest.raises(RuntimeError, match="disk on fire"):
+        next(it)
+
+
+def test_background_loader_abandoned_iteration_stops_thread(hvd):
+    before = threading.active_count()
+    it = iter(BackgroundLoader((np.zeros(1) for _ in range(100)), depth=1))
+    next(it)
+    it.close()  # generator finally -> stop event
+    deadline = time.monotonic() + 5.0
+    while threading.active_count() > before and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert threading.active_count() <= before
+
+
+def test_prefetch_to_device_values_and_sharding(hvd):
+    import jax
+
+    import horovod_tpu as hvd_mod
+
+    batches = [(np.full((8, 2), i, np.float32), np.full(8, i, np.int32))
+               for i in range(4)]
+    sharding = (hvd_mod.data_sharding(2), hvd_mod.data_sharding(1))
+    got = list(prefetch_to_device(batches, size=2, sharding=sharding))
+    assert len(got) == 4
+    for i, (x, y) in enumerate(got):
+        assert isinstance(x, jax.Array)
+        assert x.sharding.is_equivalent_to(sharding[0], x.ndim)
+        np.testing.assert_array_equal(np.asarray(x),
+                                      np.full((8, 2), i, np.float32))
+        np.testing.assert_array_equal(np.asarray(y), np.full(8, i))
+
+
+def test_prefetch_issues_puts_ahead(hvd):
+    puts = []
+
+    def counting_put(batch, *a):
+        puts.append(batch)
+        return batch
+
+    it = prefetch_to_device(range(5), size=3, device_put=counting_put)
+    first = next(it)
+    assert first == 0
+    assert len(puts) >= 3  # batch 1 and 2 already transferred

@@ -14,7 +14,14 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def _run(script, *args, timeout=420):
     env = {
         **os.environ,
-        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        # The CPU backend's collective rendezvous hard-aborts the process
+        # after 40 s if a device thread lags (rendezvous.cc "Termination
+        # timeout").  8 virtual devices oversubscribing a small CI host
+        # while another program compiles can legitimately exceed that —
+        # give the simulation slack instead of flaking.
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8"
+                     " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
+                     " --xla_cpu_collective_call_terminate_timeout_seconds=600",
         "JAX_PLATFORMS": "cpu",
         "PYTHONPATH": REPO,
     }
