@@ -51,12 +51,13 @@ for _mod, _names in {
         "allreduce_async", "allreduce_sparse", "alltoall", "alltoall_async",
         "barrier", "batch_spec", "broadcast", "broadcast_async",
         "flash_attention", "grouped_allreduce", "make_flash_attention",
-        "poll", "shard", "sparse_to_dense", "synchronize",
+        "poll", "quantized_grouped_allreduce", "shard", "sparse_to_dense",
+        "synchronize",
     ),
     "horovod_tpu.training": (
-        "DistributedOptimizer", "allgather_object", "broadcast_object",
-        "broadcast_optimizer_state", "broadcast_parameters",
-        "scale_learning_rate",
+        "DistributedOptimizer", "accumulate_gradients", "allgather_object",
+        "broadcast_object", "broadcast_optimizer_state",
+        "broadcast_parameters", "scale_learning_rate",
     ),
 }.items():
     for _n in _names:

@@ -173,11 +173,77 @@ def allreduce(tensor, average: bool = True, name: str | None = None,
     return compression.decompress(reduced, ctx)
 
 
+def quantized_grouped_allreduce(tensors: Sequence, errors: Sequence | None = None,
+                                average: bool = True,
+                                threshold_bytes: int | None = None
+                                ) -> tuple[list, list]:
+    """Fused allreduce on an int8 wire with a shared scale — 4x fewer bytes
+    than float32 (beyond the reference's cast-based Compression, reference
+    compression.py:42-63).  In-mesh only.
+
+    Per flat bucket: a scalar ``pmax`` agrees the scale across chips, values
+    quantize to at most ``±floor(127/width)`` levels so the int8 ``psum``
+    cannot overflow, and the sum dequantizes back.  ``errors`` carries error
+    feedback: each chip's local quantization residual is returned and should
+    be passed back on the next call (added to the fresh gradients), so the
+    lost precision re-enters instead of biasing training —
+    ``DistributedOptimizer(compression=Compression.int8)`` manages this
+    automatically.
+
+    Returns ``(reduced, residuals)``, both lists matching ``tensors``.
+    """
+    axes = _in_mesh_axes()
+    if axes is None:
+        raise NotImplementedError(
+            "int8 quantized allreduce is a compiled-path feature: call it "
+            "inside a step wrapped by horovod_tpu.shard (the eager/process "
+            "path wires through f32 staging already; use Compression.fp16/"
+            "bf16 there).")
+    width = _data_width(axes)
+    if width > 127:
+        raise ValueError(
+            f"int8 quantized allreduce sum-fits at most 127 workers on the "
+            f"wire (data width here: {width}); use Compression.bf16 beyond "
+            f"that, or shrink the data axis (e.g. ZeRO/hierarchical DP).")
+    qcap = max(127 // width, 1)
+    for t in tensors:
+        if not jnp.issubdtype(t.dtype, jnp.floating):
+            raise ValueError(
+                f"int8 quantization applies to floating gradients, got "
+                f"{t.dtype}")
+    if errors is not None:
+        tensors = [t + e.astype(t.dtype) for t, e in zip(tensors, errors)]
+
+    def qreduce(flat):
+        amax = lax.pmax(jnp.max(jnp.abs(flat)), axes)
+        # Guard in the working dtype: an f32-tiny floor would underflow to 0
+        # after an fp16/bf16 cast, turning all-zero buckets into 0/0 = NaN.
+        scale = jnp.maximum(amax.astype(flat.dtype) / qcap,
+                            jnp.finfo(flat.dtype).tiny)
+        q = jnp.clip(jnp.round(flat / scale), -qcap, qcap).astype(jnp.int8)
+        # |any partial or total sum| <= width*qcap <= 127: no int8 overflow,
+        # including the hierarchical ICI-scatter -> DCN -> ICI-gather route
+        # (the int8 shard is what crosses DCN — the bandwidth win compounds).
+        summed = _mesh_allreduce(q, axes)
+        deq = q.astype(flat.dtype) * scale
+        return summed.astype(flat.dtype) * scale, flat - deq
+
+    reduced, resid = fusion.fused_apply_multi(tensors, qreduce, threshold_bytes)
+    if average:
+        reduced = [r / width for r in reduced]
+    return reduced, resid
+
+
 def grouped_allreduce(tensors: Sequence, average: bool = True,
                       compression=Compression.none,
                       threshold_bytes: int | None = None) -> list:
     """Fused allreduce of many tensors via flat buckets (reference fusion
     buffer semantics, operations.cc:1807-1842; see ops/fusion.py)."""
+    if compression is Compression.int8:
+        # Stateless quantized path (no error feedback): residuals dropped.
+        reduced, _ = quantized_grouped_allreduce(
+            tensors, average=average, threshold_bytes=threshold_bytes)
+        return reduced
     axes = _in_mesh_axes()
     comp = [compression.compress(t) for t in tensors]
     if axes is not None:

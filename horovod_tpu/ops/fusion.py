@@ -97,11 +97,23 @@ def fused_apply(tensors: Sequence[jax.Array],
     """Pack ``tensors`` into flat buckets, run ``collective`` once per bucket,
     and unpack.  ``collective`` maps a 1-D buffer to a same-shape 1-D buffer
     (e.g. a ``psum``)."""
+    (out,) = fused_apply_multi(tensors, lambda flat: (collective(flat),),
+                               threshold_bytes)
+    return out
+
+
+def fused_apply_multi(tensors: Sequence[jax.Array],
+                      collective: Callable[[jax.Array], tuple],
+                      threshold_bytes: int | None = None) -> tuple[list, ...]:
+    """Like :func:`fused_apply` but ``collective`` returns a TUPLE of
+    same-length 1-D buffers per bucket (e.g. a quantized reduction that also
+    yields its local residual), each unpacked to the input shapes."""
     tensors = list(tensors)
     if not tensors:
-        return []
+        # No tensors ⇒ arity unknowable; fused_apply relies on (|outs|=1).
+        return ([],)
     buckets = plan_buckets([(t.shape, t.dtype) for t in tensors], threshold_bytes)
-    out: list[jax.Array | None] = [None] * len(tensors)
+    outs: list[list] = []
     for b in buckets:
         flat = jnp.concatenate(
             [tensors[s.index].reshape(-1) for s in b.slots]
@@ -109,8 +121,11 @@ def fused_apply(tensors: Sequence[jax.Array],
                           dtype=b.dtype)]
                if b.padded_elems > sum(s.size for s in b.slots) else [])
         )
-        reduced = collective(flat)
-        for s in b.slots:
-            out[s.index] = jax.lax.dynamic_slice_in_dim(
-                reduced, s.offset, s.size).reshape(s.shape)
-    return out  # type: ignore[return-value]
+        results = collective(flat)
+        if not outs:
+            outs = [[None] * len(tensors) for _ in results]
+        for k, reduced in enumerate(results):
+            for s in b.slots:
+                outs[k][s.index] = jax.lax.dynamic_slice_in_dim(
+                    reduced, s.offset, s.size).reshape(s.shape)
+    return tuple(outs)

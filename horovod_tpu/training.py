@@ -44,6 +44,15 @@ class DistributedState(NamedTuple):
     inner: Any
 
 
+class DistributedEFState(NamedTuple):
+    """State when int8 compression is active: inner optimizer state plus the
+    per-parameter error-feedback residual (quantization error carried into
+    the next step's gradients)."""
+
+    inner: Any
+    error: Any
+
+
 def DistributedOptimizer(optimizer: optax.GradientTransformation,
                          *,
                          average: bool = True,
@@ -79,6 +88,30 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
 
         return zero_optimizer(optimizer, average=average)
 
+    if compression is Compression.int8:
+        # int8 wire with error feedback: the quantization residual is state
+        # (DistributedEFState.error) and re-enters the next step's
+        # gradients, so precision lost to the 8-bit wire accumulates back
+        # instead of biasing training.
+        def init(params):
+            return DistributedEFState(
+                inner=optimizer.init(params),
+                error=jax.tree.map(jnp.zeros_like, params))
+
+        def update(grads, state, params=None, **extra):
+            leaves, treedef = jax.tree.flatten(grads)
+            err_leaves = jax.tree.leaves(state.error)
+            reduced, resid = collective_ops.quantized_grouped_allreduce(
+                leaves, err_leaves, average=average,
+                threshold_bytes=threshold_bytes)
+            grads = jax.tree.unflatten(treedef, reduced)
+            updates, inner = optimizer.update(grads, state.inner, params,
+                                              **extra)
+            return updates, DistributedEFState(
+                inner=inner, error=jax.tree.unflatten(treedef, resid))
+
+        return optax.GradientTransformation(init, update)
+
     def init(params):
         return DistributedState(inner=optimizer.init(params))
 
@@ -97,6 +130,52 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
 def scale_learning_rate(lr: float, backward_passes_per_step: int = 1) -> float:
     """Linear LR scaling by total chip count (reference README.md:195-200)."""
     return lr * basics.num_chips() * backward_passes_per_step
+
+
+def accumulate_gradients(grad_fn, params, batch, num_microbatches: int):
+    """Gradient accumulation over microbatches — ``backward_passes_per_step``
+    for the compiled path.
+
+    The reference's torch optimizer accumulates ``backward_passes_per_step``
+    backward passes before one fused allreduce+step (torch/__init__.py:62-112);
+    on TPU the idiomatic form is a ``lax.scan`` device loop over microbatches
+    inside one compiled program, trading peak activation memory for steps.
+
+    ``grad_fn(params, microbatch) -> (loss, grads)`` (e.g. from
+    ``jax.value_and_grad(..., has_aux=...)`` composed however you like);
+    ``batch`` is a pytree whose leaves' leading axis is split into
+    ``num_microbatches`` equal chunks.  Returns ``(mean_loss, mean_grads)``
+    — identical numerics to one full-batch pass for mean-reduced losses, so
+    it composes with ``DistributedOptimizer`` unchanged (average over chips
+    of a mean over microbatches).
+    """
+    if num_microbatches < 1:
+        raise ValueError("num_microbatches must be >= 1")
+
+    def split(a):
+        if a.shape[0] % num_microbatches != 0:
+            raise ValueError(
+                f"leading axis {a.shape[0]} not divisible by "
+                f"num_microbatches={num_microbatches}")
+        return a.reshape((num_microbatches, a.shape[0] // num_microbatches)
+                         + a.shape[1:])
+
+    mb = jax.tree.map(split, batch)
+    first = jax.tree.map(lambda a: a[0], mb)
+    shapes = jax.eval_shape(grad_fn, params, first)
+    zeros = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+    def body(acc, chunk):
+        # Tree-structured adds: has_aux grad_fns return ((loss, aux), grads),
+        # so the loss slot is itself a pytree — aux accumulates (and is
+        # averaged) alongside the loss.
+        out = grad_fn(params, chunk)
+        return jax.tree.map(jnp.add, acc, out), None
+
+    (total_loss, total_grads), _ = jax.lax.scan(body, zeros, mb)
+    inv = 1.0 / num_microbatches
+    return (jax.tree.map(lambda v: v * inv, total_loss),
+            jax.tree.map(lambda g: g * inv, total_grads))
 
 
 # ---------------------------------------------------------------------------

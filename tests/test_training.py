@@ -87,3 +87,84 @@ def test_broadcast_object(hvd):
 
 def test_scale_learning_rate(hvd):
     assert hvd.scale_learning_rate(0.1) == pytest.approx(0.1 * hvd.num_chips())
+
+
+def test_accumulate_gradients_matches_full_batch(hvd):
+    """Mean-reduced loss ⇒ accumulated microbatch grads == full-batch grads
+    (the backward_passes_per_step contract, reference torch/__init__.py:62-112)."""
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(16, 4).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, 3, 16).astype(np.int32))
+    params = {"w": jnp.asarray(rng.randn(4, 3).astype(np.float32))}
+
+    def grad_fn(p, batch):
+        xb, yb = batch
+
+        def loss_fn(p):
+            return optax.softmax_cross_entropy_with_integer_labels(
+                xb @ p["w"], yb).mean()
+
+        return jax.value_and_grad(loss_fn)(p)
+
+    full_loss, full_grads = grad_fn(params, (x, y))
+    for n_mb in (1, 2, 4):
+        loss, grads = hvd.accumulate_gradients(grad_fn, params, (x, y), n_mb)
+        np.testing.assert_allclose(float(loss), float(full_loss), rtol=1e-5)
+        np.testing.assert_allclose(grads["w"], full_grads["w"], rtol=1e-5)
+
+
+def test_accumulate_gradients_inside_sharded_step(hvd):
+    """Composes with DistributedOptimizer under hvd.shard: microbatch mean
+    then chip-average equals the global full-batch gradient."""
+    n = hvd.num_chips()
+    x = jnp.arange(8 * n, dtype=jnp.float32).reshape(-1, 1)
+
+    @hvd.shard(in_specs=hvd.batch_spec(2), out_specs=P())
+    def step(xb):
+        params = {"w": jnp.ones((1,))}
+
+        def grad_fn(p, mb):
+            loss = jnp.mean((mb[:, 0] * p["w"][0]) ** 2)
+            return loss, jax.grad(lambda q: jnp.mean(
+                (mb[:, 0] * q["w"][0]) ** 2))(p)
+
+        _, grads = hvd.accumulate_gradients(grad_fn, params, xb, 4)
+        return hvd.allreduce(grads["w"], average=True)
+
+    got = step(x)
+    want = np.mean(2 * np.arange(8 * n, dtype=np.float32) ** 2)
+    np.testing.assert_allclose(np.asarray(got)[0], want, rtol=1e-5)
+
+
+def test_accumulate_gradients_validates(hvd):
+    def grad_fn(p, b):
+        return jnp.sum(b), p
+
+    with pytest.raises(ValueError, match="divisible"):
+        hvd.accumulate_gradients(grad_fn, {"w": jnp.ones(1)},
+                                 jnp.ones((10, 2)), 3)
+    with pytest.raises(ValueError, match=">= 1"):
+        hvd.accumulate_gradients(grad_fn, {"w": jnp.ones(1)},
+                                 jnp.ones((10, 2)), 0)
+
+
+def test_accumulate_gradients_has_aux(hvd):
+    """grad_fn from value_and_grad(..., has_aux=True) returns
+    ((loss, aux), grads); aux accumulates and averages alongside."""
+    x = jnp.arange(8.0).reshape(4, 2)
+    params = {"w": jnp.ones((2,))}
+
+    def grad_fn(p, xb):
+        def loss_fn(p):
+            pred = xb @ p["w"]
+            return jnp.mean(pred ** 2), jnp.sum(pred)
+
+        return jax.value_and_grad(loss_fn, has_aux=True)(p)
+
+    (loss, aux), grads = hvd.accumulate_gradients(grad_fn, params, x, 2)
+    (floss, faux), fgrads = grad_fn(params, x)
+    np.testing.assert_allclose(float(loss), float(floss), rtol=1e-6)
+    # aux is averaged over microbatches: per-mb sums average to half the
+    # full-batch sum here
+    np.testing.assert_allclose(float(aux), float(faux) / 2, rtol=1e-6)
+    np.testing.assert_allclose(grads["w"], fgrads["w"], rtol=1e-6)
