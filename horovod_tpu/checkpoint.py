@@ -14,39 +14,106 @@ atomic renames); these helpers wrap it with the contract applied.
 
 from __future__ import annotations
 
+import atexit
 import os
+import threading
 from typing import Any
 
 import jax
+import numpy as np
 
 from horovod_tpu import basics, training
 
 
-def _lone_checkpointer():
-    """A PyTree checkpointer whose multihost barriers span ONLY the calling
-    process.  Orbax's default Checkpointer syncs across every JAX process on
+def _lone_mp_options(prefix: str):
+    """Subset-barrier options spanning ONLY the calling process, or None in
+    single-process jobs.  Orbax's defaults sync across every JAX process on
     save/restore; since this module rank-gates the filesystem work (only
-    ``root_rank`` calls orbax at all), the default would deadlock waiting
-    for processes that never enter orbax — the subset barrier keeps the
-    single caller self-consistent instead."""
-    import jax
+    ``root_rank`` calls orbax at all), the defaults would deadlock waiting
+    for processes that never enter orbax."""
     import orbax.checkpoint as ocp
 
-    if jax.process_count() > 1:
-        me = jax.process_index()
-        mp = ocp.options.MultiprocessingOptions(
-            primary_host=me, active_processes={me},
-            barrier_sync_key_prefix=f"hvd_lone_{me}")
+    if jax.process_count() <= 1:
+        return None
+    me = jax.process_index()
+    return ocp.options.MultiprocessingOptions(
+        primary_host=me, active_processes={me},
+        barrier_sync_key_prefix=f"{prefix}_{me}")
+
+
+def _lone_checkpointer():
+    """A PyTree checkpointer with the lone-process barriers (see
+    :func:`_lone_mp_options`)."""
+    import orbax.checkpoint as ocp
+
+    mp = _lone_mp_options("hvd_lone")
+    if mp is not None:
         return ocp.Checkpointer(ocp.PyTreeCheckpointHandler(),
                                 multiprocessing_options=mp)
     return ocp.PyTreeCheckpointer()
 
 
-def save(path: str | os.PathLike, state: Any, *, force: bool = True) -> None:
-    """Write ``state`` (any pytree) at ``path``; no-op off rank 0."""
+_async_lock = threading.Lock()
+_async_ckptr = None
+
+
+def _get_async_checkpointer():
+    """Singleton AsyncCheckpointer (it owns a worker thread); built with the
+    same lone-process barrier options as the sync path."""
+    global _async_ckptr
+    import orbax.checkpoint as ocp
+
+    with _async_lock:
+        if _async_ckptr is None:
+            mp = _lone_mp_options("hvd_lone_async")
+            if mp is not None:
+                _async_ckptr = ocp.AsyncCheckpointer(
+                    ocp.PyTreeCheckpointHandler(),
+                    multiprocessing_options=mp)
+            else:
+                _async_ckptr = ocp.AsyncCheckpointer(
+                    ocp.PyTreeCheckpointHandler())
+            atexit.register(wait_pending)
+        return _async_ckptr
+
+
+def wait_pending() -> None:
+    """Block until any in-flight background save has committed (no-op when
+    nothing is pending or off rank 0).  Called automatically at exit so a
+    program that ends right after a background save cannot lose it."""
+    with _async_lock:
+        ck = _async_ckptr
+    if ck is not None:
+        ck.wait_until_finished()
+
+
+def save(path: str | os.PathLike, state: Any, *, force: bool = True,
+         background: bool = False) -> None:
+    """Write ``state`` (any pytree) at ``path``; no-op off rank 0.
+
+    ``background=True`` returns as soon as the state is snapshotted and
+    commits the write on a worker thread (orbax AsyncCheckpointer) so
+    training steps overlap checkpoint IO — the TPU-idiomatic way to hide
+    multi-second writes of large states.  A subsequent save (or process
+    exit, or :func:`wait_pending`) waits for the previous commit first;
+    the atomic-rename contract is unchanged.  The first background save
+    pays orbax's one-time worker setup (~seconds) synchronously; steady-
+    state kick cost is tens of milliseconds.
+    """
     if basics.rank() != 0:
         return
     path = os.path.abspath(os.fspath(path))
+    if background:
+        # Orbax copies device arrays before returning but writes host numpy
+        # leaves from the caller's live buffers — snapshot those so later
+        # in-place mutation cannot tear the checkpoint.
+        state = jax.tree.map(
+            lambda v: v.copy() if isinstance(v, np.ndarray) else v, state)
+        _get_async_checkpointer().save(path, state, force=force)
+        return
+    # A sync save must not race an in-flight background commit to the same
+    # tree (orbax serializes only against its own instance).
+    wait_pending()
     with _lone_checkpointer() as ckptr:
         ckptr.save(path, state, force=force)
 
@@ -64,6 +131,7 @@ def restore(path: str | os.PathLike, template: Any | None = None,
     def read():
         import orbax.checkpoint as ocp
 
+        wait_pending()  # a pending background save must be visible to reads
         p = os.path.abspath(os.fspath(path))
         with _lone_checkpointer() as ckptr:
             if template is not None:
@@ -80,6 +148,7 @@ def restore(path: str | os.PathLike, template: Any | None = None,
 
 
 def exists(path: str | os.PathLike) -> bool:
+    wait_pending()
     return os.path.isdir(os.fspath(path))
 
 
@@ -91,6 +160,8 @@ def resume_epoch(path: str | os.PathLike, root_rank: int = 0) -> int:
     saved under ``path/epoch_<N>``; workers may see stale filesystems, so
     only rank 0 lists."""
     epoch = -1
+    if basics.rank() == root_rank:
+        wait_pending()  # count background saves that are still committing
     if basics.rank() == root_rank and os.path.isdir(os.fspath(path)):
         for entry in os.listdir(os.fspath(path)):
             if entry.startswith("epoch_"):
@@ -101,8 +172,10 @@ def resume_epoch(path: str | os.PathLike, root_rank: int = 0) -> int:
     return int(training.broadcast_object(epoch, root_rank=root_rank))
 
 
-def save_epoch(path: str | os.PathLike, epoch: int, state: Any) -> None:
-    save(os.path.join(os.fspath(path), f"epoch_{epoch}"), state)
+def save_epoch(path: str | os.PathLike, epoch: int, state: Any,
+               background: bool = False) -> None:
+    save(os.path.join(os.fspath(path), f"epoch_{epoch}"), state,
+         background=background)
 
 
 def restore_epoch(path: str | os.PathLike, epoch: int,

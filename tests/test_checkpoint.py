@@ -28,3 +28,25 @@ def test_epoch_resume(hvd, tmp_path):
     assert checkpoint.resume_epoch(base) == 3
     out = checkpoint.restore_epoch(base, 3)
     np.testing.assert_array_equal(out["w"], np.ones(3) * 3)
+
+
+def test_background_save_commits_and_round_trips(hvd, tmp_path):
+    state = {"w": jnp.linspace(0, 1, 8), "step": jnp.array(3)}
+    p = tmp_path / "bg"
+    checkpoint.save(p, state, background=True)   # returns immediately
+    checkpoint.wait_pending()
+    assert checkpoint.exists(p)
+    out = checkpoint.restore(p)
+    np.testing.assert_array_equal(out["w"], state["w"])
+    assert int(out["step"]) == 3
+
+
+def test_background_saves_serialize(hvd, tmp_path):
+    """A second background save waits for the first commit; both land."""
+    for i in range(3):
+        checkpoint.save_epoch(tmp_path / "bgs", i, {"x": jnp.full(4, float(i))},
+                              background=True)
+    checkpoint.wait_pending()
+    assert checkpoint.resume_epoch(tmp_path / "bgs") == 2
+    out = checkpoint.restore_epoch(tmp_path / "bgs", 1)
+    np.testing.assert_array_equal(out["x"], np.full(4, 1.0))
