@@ -101,12 +101,18 @@ def main():
     gb = args.batch_size * size
     rng_np = np.random.RandomState(hvd.rank())
 
+    def synthetic_batches():
+        # Stand-in for a real decode/augment pipeline: generation runs on
+        # the loader thread, device transfer double-buffers under compute.
+        for _ in range(spe):
+            yield (rng_np.rand(gb, 224, 224, 3).astype(np.float32),
+                   rng_np.randint(0, 1000, gb).astype(np.int32))
+
     for epoch in range(resume + 1, args.epochs):
         t0 = time.time()
         loss = None
-        for _ in range(spe):
-            x = jnp.asarray(rng_np.rand(gb, 224, 224, 3), jnp.float32)
-            y = jnp.asarray(rng_np.randint(0, 1000, gb))
+        for x, y in hvd.data.prefetch_to_device(
+                hvd.data.BackgroundLoader(synthetic_batches())):
             params, batch_stats, opt_state, loss = train_step(
                 params, batch_stats, opt_state, x, y)
         jax.block_until_ready(loss)
@@ -114,9 +120,12 @@ def main():
         if hvd.rank() == 0:
             print(f"epoch {epoch}: loss={float(loss):.3f} "
                   f"{spe * gb / dt:.1f} img/s")
+        # Background save: the next epoch's steps overlap the write
+        # (anything reading the checkpoint waits for the commit).
         hvd.checkpoint.save_epoch(args.ckpt_dir, epoch,
                                   {"params": params,
-                                   "batch_stats": batch_stats})
+                                   "batch_stats": batch_stats},
+                                  background=True)
 
 
 if __name__ == "__main__":

@@ -44,12 +44,14 @@ class TrainState(train_state.TrainState):
         return cls.create(apply_fn=apply_fn, params=params, tx=dtx, **kwargs)
 
 
-def save_model(path, state: train_state.TrainState) -> None:
+def save_model(path, state: train_state.TrainState,
+               background: bool = False) -> None:
     """Rank-0 checkpoint of params + opt_state + step (reference Keras
-    ``ModelCheckpoint``-on-rank-0 contract)."""
+    ``ModelCheckpoint``-on-rank-0 contract).  ``background=True`` overlaps
+    the write with training (checkpoint.save's async path)."""
     checkpoint.save(path, {"params": state.params,
                            "opt_state": state.opt_state,
-                           "step": state.step})
+                           "step": state.step}, background=background)
 
 
 def load_model(path, *, apply_fn, tx: optax.GradientTransformation,
