@@ -385,6 +385,13 @@ def flash_attention(q, k, v, causal: bool = True, q_offset=0, k_offset=0,
 
     ``q_offset``/``k_offset`` are global sequence positions of the first
     row/col (sequence-parallel shards pass shard_index × shard_len).
+
+    Block sizes bound the kernel's VMEM working set; a (512, 512) pair is
+    the measured throughput optimum on v5e at both S=1024 and S=8192
+    (docs/benchmarks.md round-2 sweep), while ``block_k`` ≥ 1024 overflows
+    the 16 MiB scoped-VMEM stack in the backward kernel at long S
+    ("Ran out of memory in memory space vmem") — stay at ≤512 unless you
+    re-derive the bound for your head_dim.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
