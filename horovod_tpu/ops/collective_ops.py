@@ -155,8 +155,15 @@ def allreduce(tensor, average: bool = True, name: str | None = None,
     In-mesh: one ``lax.psum`` over the chip axis (the reference's fused
     MPI_Allreduce/ncclAllReduce, operations.cc:954-1311).  Eager: process-level
     reduction.  ``compression`` casts to the wire dtype around the collective
-    (reference tensorflow/__init__.py:80-87).
+    (reference tensorflow/__init__.py:80-87); ``Compression.int8`` routes to
+    the quantized in-mesh collective (shared scale, no error feedback at
+    this granularity — use DistributedOptimizer for that).
     """
+    if compression is Compression.int8:
+        if prescale_factor != 1.0:
+            tensor = tensor * prescale_factor
+        (reduced,), _ = quantized_grouped_allreduce([tensor], average=average)
+        return reduced
     axes = _in_mesh_axes()
     compressed, ctx = compression.compress(tensor)
     if prescale_factor != 1.0:

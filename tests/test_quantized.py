@@ -229,3 +229,19 @@ def test_quantized_rejects_width_over_127(hvd, monkeypatch):
 
     with pytest.raises(ValueError, match="127"):
         reduce_q(jnp.ones((hvd.num_chips(), 4)))
+
+
+def test_single_allreduce_int8_routes_to_quantized(hvd):
+    n = hvd.num_chips()
+    rng = np.random.RandomState(9)
+    vals = rng.randn(n, 12).astype(np.float32)
+
+    @_chipwise
+    def reduce_one(x):
+        return hvd.allreduce(x[0], average=True,
+                             compression=hvd.Compression.int8)
+
+    got = np.asarray(reduce_one(jnp.asarray(vals)))
+    qcap = max(127 // n, 1)
+    scale = np.abs(vals).max() / qcap
+    np.testing.assert_allclose(got, vals.mean(axis=0), atol=scale / 2 + 1e-7)
