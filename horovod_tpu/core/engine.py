@@ -40,6 +40,10 @@ _LIB_PATH = os.path.join(_HERE, os.environ.get("HVD_CORE_LIB",
 
 # Wire enums — must match core/src/common.h and message.h.
 OP_ALLREDUCE, OP_ALLGATHER, OP_BROADCAST, OP_ALLTOALL, OP_BARRIER = range(5)
+
+# Wire formats (core/src/message.h WireFormat): NATIVE ships the tensor's
+# own dtype; INT8 ships (f32 scale, int8 values) per rank — allreduce only.
+WIRE_NATIVE, WIRE_INT8 = 0, 1
 RESP_ERROR = 5
 
 STATUS_OK = 0
@@ -88,7 +92,7 @@ def _load_library() -> ctypes.CDLL:
     lib.hvd_enqueue.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
         ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_int,
-        ctypes.c_char_p, ctypes.c_int]
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
     lib.hvd_next_batch.restype = ctypes.c_int
     lib.hvd_next_batch.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                    ctypes.c_int, ctypes.c_double]
@@ -131,8 +135,8 @@ def lib() -> ctypes.CDLL:
 class ExecBatch:
     """Parsed fused batch from hvd_next_batch (wire layout in c_api.cc)."""
 
-    __slots__ = ("id", "type", "dtype", "root_rank", "names", "handles",
-                 "shapes", "first_dim_sizes")
+    __slots__ = ("id", "type", "dtype", "root_rank", "wire", "names",
+                 "handles", "shapes", "first_dim_sizes")
 
     def __init__(self, raw: bytes):
         off = 0
@@ -166,6 +170,7 @@ class ExecBatch:
         self.type = u8()
         self.dtype = u8()
         self.root_rank = i32()
+        self.wire = u8()
         n = i32()
         self.names, self.handles, self.shapes = [], [], []
         for _ in range(n):
@@ -223,13 +228,18 @@ class NativeEngine:
     # -- client API ---------------------------------------------------------
 
     def enqueue(self, name: str, array: np.ndarray, op: int,
-                root_rank: int = -1) -> int:
+                root_rank: int = -1, wire: int = WIRE_NATIVE) -> int:
         """Announce a tensor; returns an async handle (reference
         EnqueueTensorAllreduce, operations.cc:2025-2061)."""
         arr = np.ascontiguousarray(array)
         dtype_id = DTYPES.get(arr.dtype.name)
         if dtype_id is None:
             raise TypeError(f"unsupported dtype {arr.dtype}")
+        if wire == WIRE_INT8 and (
+                op != OP_ALLREDUCE
+                or (arr.dtype.kind != "f" and arr.dtype.name != "bfloat16")):
+            raise ValueError(
+                "int8 wire format applies to floating-point allreduce only")
         dims = (ctypes.c_longlong * max(arr.ndim, 1))(*arr.shape)
         err = ctypes.create_string_buffer(512)
         with self._store_lock:
@@ -242,7 +252,7 @@ class NativeEngine:
                     f"this tensor has not completed.")
             self._store[name] = arr
         h = self._lib.hvd_enqueue(self._ptr, name.encode(), op, dtype_id,
-                                  dims, arr.ndim, root_rank, err, 512)
+                                  dims, arr.ndim, root_rank, wire, err, 512)
         if h < 0:
             with self._store_lock:
                 self._store.pop(name, None)

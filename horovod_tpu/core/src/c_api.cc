@@ -73,13 +73,13 @@ void hvd_destroy(void* e) { delete static_cast<Engine*>(e); }
 
 long long hvd_enqueue(void* e, const char* name, int op, int dtype,
                       const long long* dims, int ndims, int root_rank,
-                      char* err, int errlen) {
+                      int wire, char* err, int errlen) {
   TensorShape shape;
   shape.dims.assign(dims, dims + ndims);
   Status s;
   int64_t h = static_cast<Engine*>(e)->Enqueue(
       name, static_cast<OpType>(op), static_cast<DataType>(dtype), shape,
-      root_rank, &s);
+      root_rank, static_cast<hvd::WireFormat>(wire), &s);
   if (h < 0) CopyErr(s.reason, err, errlen);
   return h;
 }
@@ -95,6 +95,7 @@ int hvd_next_batch(void* e, char* buf, int buflen, double timeout_ms) {
   w.u8(static_cast<uint8_t>(b.type));
   w.u8(static_cast<uint8_t>(b.dtype));
   w.i32(b.root_rank);
+  w.u8(static_cast<uint8_t>(b.wire));
   w.i32(static_cast<int32_t>(b.names.size()));
   for (size_t i = 0; i < b.names.size(); ++i) {
     w.str(b.names[i]);

@@ -316,6 +316,11 @@ void Coordinator::Ingest(const Request& req) {
     err << "Mismatched dtypes for tensor " << req.name << ": rank "
         << req.rank << " sent " << DataTypeName(req.dtype) << " but rank "
         << rec.first.rank << " sent " << DataTypeName(rec.first.dtype) << ".";
+  } else if (req.wire != rec.first.wire) {
+    err << "Mismatched wire formats for tensor " << req.name << ": rank "
+        << req.rank << " sent " << WireFormatName(req.wire) << " but rank "
+        << rec.first.rank << " sent " << WireFormatName(rec.first.wire)
+        << ".";
   } else if (req.op == OpType::BROADCAST &&
              req.root_rank != rec.first.root_rank) {
     err << "Mismatched root ranks for broadcast " << req.name << ": rank "

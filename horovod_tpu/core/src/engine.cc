@@ -47,7 +47,7 @@ void Engine::Shutdown() { shutdown_requested_.store(true); }
 
 int64_t Engine::Enqueue(const std::string& name, OpType op, DataType dtype,
                         const TensorShape& shape, int32_t root_rank,
-                        Status* status) {
+                        WireFormat wire, Status* status) {
   std::lock_guard<std::mutex> l(mu_);
   if (stopped_.load() || shutdown_requested_.load()) {
     *status = Status::Aborted("Horovod engine has been shut down.");
@@ -67,6 +67,7 @@ int64_t Engine::Enqueue(const std::string& name, OpType op, DataType dtype,
   req.op = op;
   req.dtype = dtype;
   req.root_rank = root_rank;
+  req.wire = wire;
   req.name = name;
   req.shape = shape;
   int64_t handle = next_handle_++;
@@ -195,6 +196,7 @@ void Engine::DispatchResponses(const ResponseList& responses) {
         batch.shapes.push_back(req.shape);
         batch.dtype = req.dtype;
         batch.root_rank = req.root_rank;
+        batch.wire = req.wire;
       }
       batch.first_dim_sizes.insert(batch.first_dim_sizes.end(),
                                    resp.first_dim_sizes.begin(),
@@ -215,7 +217,7 @@ void Engine::DispatchResponses(const ResponseList& responses) {
         if (it == inflight_.end()) break;
         const Request& req = it->second.second;
         int64_t add = req.shape.num_elements() * DataTypeSize(req.dtype);
-        if (req.dtype != batch.dtype ||
+        if (req.dtype != batch.dtype || req.wire != batch.wire ||
             bytes + add > opts_.fusion_threshold_bytes) {
           break;
         }

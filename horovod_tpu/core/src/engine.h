@@ -38,6 +38,7 @@ struct ExecBatch {
   Response::Type type = Response::Type::ALLREDUCE;
   DataType dtype = DataType::FLOAT32;
   int32_t root_rank = -1;
+  WireFormat wire = WireFormat::NATIVE;
   // Parallel arrays: tensor names and their client handles.
   std::vector<std::string> names;
   std::vector<int64_t> handles;
@@ -71,7 +72,8 @@ class Engine {
   // operations.cc:2025-2141).  Returns a handle (>=0) or -1 with *status set
   // (duplicate name, shut down).
   int64_t Enqueue(const std::string& name, OpType op, DataType dtype,
-                  const TensorShape& shape, int32_t root_rank, Status* status);
+                  const TensorShape& shape, int32_t root_rank,
+                  WireFormat wire, Status* status);
 
   // Executor API.  Blocks up to timeout_ms for the next fused batch.
   // Returns: 1 = batch filled, 0 = timeout, -1 = shutdown (queue drained).

@@ -15,6 +15,14 @@ const char* OpTypeName(OpType t) {
   return "?";
 }
 
+const char* WireFormatName(WireFormat w) {
+  switch (w) {
+    case WireFormat::NATIVE: return "native";
+    case WireFormat::INT8: return "int8";
+  }
+  return "?";
+}
+
 namespace {
 
 constexpr size_t kMaxString = 1 << 20;   // sanity bound on names/reasons
@@ -73,6 +81,7 @@ void Serialize(const RequestList& in, std::string* out) {
     w.u8(static_cast<uint8_t>(r.op));
     w.u8(static_cast<uint8_t>(r.dtype));
     w.i32(r.root_rank);
+    w.u8(static_cast<uint8_t>(r.wire));
     w.str(r.name);
     w.i32(static_cast<int32_t>(r.shape.dims.size()));
     for (auto d : r.shape.dims) w.i64(d);
@@ -92,6 +101,7 @@ bool Deserialize(const char* data, size_t len, RequestList* out) {
     q.op = static_cast<OpType>(r.u8());
     q.dtype = static_cast<DataType>(r.u8());
     q.root_rank = r.i32();
+    q.wire = static_cast<WireFormat>(r.u8());
     q.name = r.str();
     int32_t nd = r.i32();
     if (r.fail || nd < 0 || static_cast<size_t>(nd) > kMaxVector) return false;

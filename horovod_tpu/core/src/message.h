@@ -27,6 +27,18 @@ enum class OpType : int8_t {
 
 const char* OpTypeName(OpType t);
 
+// On-the-wire payload encoding for the executor's data plane.  NATIVE
+// moves the tensor's own dtype; INT8 ships each rank's contribution as
+// (f32 scale, int8 values) — 4x fewer bytes than f32 — and the receiver
+// dequant-sums in f32 (allreduce only; beyond the reference's cast-based
+// Compression, reference compression.py:42-63).
+enum class WireFormat : int8_t {
+  NATIVE = 0,
+  INT8 = 1,
+};
+
+const char* WireFormatName(WireFormat w);
+
 // One tensor's readiness announcement (reference MPIRequest:
 // mpi_message.h:48-90 — {request_rank, type, dtype, name, root_rank, device,
 // shape}; "device" is dropped: one process drives all its local chips).
@@ -35,6 +47,7 @@ struct Request {
   OpType op = OpType::ALLREDUCE;
   DataType dtype = DataType::FLOAT32;
   int32_t root_rank = -1;
+  WireFormat wire = WireFormat::NATIVE;
   std::string name;
   TensorShape shape;
 };

@@ -58,6 +58,17 @@ def allreduce_async(tensor, average: bool = True, name: str | None = None,
         raise ValueError(
             f"allreduce(average=True) is not supported for integer dtype "
             f"{arr.dtype}; use average=False and divide explicitly.")
+    if compression is Compression.int8:
+        # Not a cast: the engine ships (scale, int8) per rank and the
+        # executor dequant-sums (core/executors.py) — the eager analog of
+        # quantized_grouped_allreduce, negotiated like any other wire
+        # (mismatched wire formats error on every rank).
+        h = eng.enqueue(_auto_name("allreduce", name), arr,
+                        engine_mod.OP_ALLREDUCE,
+                        wire=engine_mod.WIRE_INT8)
+        with _meta_lock:
+            _meta[h] = {"average": average}
+        return h
     compressed, ctx = compression.compress(arr)
     compressed = np.asarray(compressed)
     h = eng.enqueue(_auto_name("allreduce", name), compressed,

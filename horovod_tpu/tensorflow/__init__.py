@@ -22,6 +22,7 @@ from horovod_tpu.core.objects import broadcast_object as _broadcast_object
 
 _bcast_counter = itertools.count()
 
+from horovod_tpu.core import engine as engine_mod  # noqa: E402
 from horovod_tpu.tensorflow.compression import Compression  # noqa: E402
 from horovod_tpu.tensorflow.mpi_ops import (  # noqa: F401
     _allreduce, allgather, alltoall, broadcast, init, shutdown, size, local_size,
@@ -51,8 +52,10 @@ def allreduce(tensor, average=True, device_dense='', device_sparse='',
         return tf.IndexedSlices(values, indices,
                                 dense_shape=tensor.dense_shape)
     tensor = tf.convert_to_tensor(tensor)
+    wire = (engine_mod.WIRE_INT8 if compression is Compression.int8
+            else engine_mod.WIRE_NATIVE)
     tensor_compressed, ctx = compression.compress(tensor)
-    summed = _allreduce(tensor_compressed, name=name)
+    summed = _allreduce(tensor_compressed, name=name, wire=wire)
     summed = compression.decompress(summed, ctx)
     if not average:
         return summed

@@ -58,9 +58,16 @@ class BF16Compressor(_CastCompressor):
     wire_dtype = tf.bfloat16
 
 
+class Int8Compressor(NoneCompressor):
+    """int8 wire marker — not a cast: the native engine ships (f32 scale,
+    int8 values) per rank and dequant-sums in f32 (core/executors.py).
+    Routed by ``allreduce``; compress/decompress are identities."""
+
+
 class Compression:
     """Registry, mirroring reference compression.py:66-74."""
 
     none = NoneCompressor
     fp16 = FP16Compressor
     bf16 = BF16Compressor
+    int8 = Int8Compressor

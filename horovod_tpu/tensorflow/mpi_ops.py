@@ -47,7 +47,8 @@ _OP_PREFIX = {
 }
 
 
-def _collective(tensor, op: int, name: str | None, root_rank: int = -1):
+def _collective(tensor, op: int, name: str | None, root_rank: int = -1,
+                wire: int = 0):
     """Run one engine collective on a tf tensor (sync), graph-compatible."""
     tensor = tf.convert_to_tensor(tensor)
     # The engine works on buffers with a leading axis; round-trip scalars
@@ -68,7 +69,7 @@ def _collective(tensor, op: int, name: str | None, root_rank: int = -1):
     def _run(t):
         eng = engine_mod.get_engine()
         arr = np.ascontiguousarray(t.numpy())
-        h = eng.enqueue(n, arr, op, root_rank=root_rank)
+        h = eng.enqueue(n, arr, op, root_rank=root_rank, wire=wire)
         return eng.synchronize(h)
 
     out = tf.py_function(_run, [tensor], Tout=tensor.dtype)
@@ -84,7 +85,7 @@ def _collective(tensor, op: int, name: str | None, root_rank: int = -1):
     return out
 
 
-def _allreduce(tensor, name=None):
+def _allreduce(tensor, name=None, wire=0):
     """Sum ``tensor`` over all processes (reference mpi_ops.py:77-90).
 
     Differentiable: grad(allreduce) = allreduce (reference mpi_ops.py:93-104).
@@ -92,10 +93,10 @@ def _allreduce(tensor, name=None):
 
     @tf.custom_gradient
     def _fn(x):
-        y = _collective(x, engine_mod.OP_ALLREDUCE, name)
+        y = _collective(x, engine_mod.OP_ALLREDUCE, name, wire=wire)
 
         def grad(dy):
-            return _allreduce(dy)
+            return _allreduce(dy, wire=wire)
 
         return y, grad
 

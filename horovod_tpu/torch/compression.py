@@ -55,7 +55,15 @@ class BF16Compressor(_CastCompressor):
     wire_dtype = torch.bfloat16
 
 
+class Int8Compressor(NoneCompressor):
+    """int8 wire marker — not a cast.  The native engine ships each rank's
+    contribution as (f32 scale per tensor, int8 values) and the executor
+    dequant-sums in f32 (core/executors.py); allreduce only.  Routed by the
+    op layer — identity compress/decompress inherited."""
+
+
 class Compression:
     none = NoneCompressor
     fp16 = FP16Compressor
     bf16 = BF16Compressor
+    int8 = Int8Compressor

@@ -55,8 +55,10 @@ def _enqueue(prefix, tensor, op, name, root_rank=-1, average=False,
              compression=Compression.none, inplace_into=None) -> int:
     eng = engine_mod.get_engine()
     compressed, ctx = compression.compress(tensor)
+    wire = (engine_mod.WIRE_INT8 if compression is Compression.int8
+            else engine_mod.WIRE_NATIVE)
     h = eng.enqueue(_auto_name(prefix, name), _to_numpy(compressed), op,
-                    root_rank=root_rank)
+                    root_rank=root_rank, wire=wire)
     _handles[h] = {"average": average, "compression": compression,
                    "ctx": ctx, "template": tensor,
                    "inplace_into": inplace_into}

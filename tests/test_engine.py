@@ -19,13 +19,14 @@ import time
 import numpy as np
 import pytest
 
-from horovod_tpu.core.engine import (
+from horovod_tpu.core.engine import (  # noqa: I001
     OP_ALLGATHER,
     OP_ALLREDUCE,
     OP_BROADCAST,
     CollectiveError,
     NativeEngine,
 )
+from horovod_tpu.core.engine import WIRE_INT8, WIRE_NATIVE
 from horovod_tpu.core.executors import local_executor
 
 from _timing import scaled
@@ -317,3 +318,69 @@ def test_mismatch_error_propagation(fn, needle):
     results = _run_spawn(fn)
     assert {r[0] for r in results} == {"collective-error"}, results
     assert all(needle in r[2] for r in results), results
+
+
+def test_fusion_respects_wire_boundary():
+    """Same dtype but different wire formats must not share a fused batch —
+    the executor encodes a whole batch uniformly."""
+    batches = []
+
+    def counting_executor(eng, batch):
+        batches.append((batch.wire, list(batch.names)))
+        local_executor(eng, batch)
+
+    eng = NativeEngine(0, 1, executor=counting_executor, cycle_time_ms=20.0)
+    try:
+        hs = [
+            eng.enqueue("w.native", np.ones(4, np.float32), OP_ALLREDUCE),
+            eng.enqueue("w.q8", np.ones(4, np.float32), OP_ALLREDUCE,
+                        wire=WIRE_INT8),
+            eng.enqueue("w.native2", np.ones(4, np.float32), OP_ALLREDUCE),
+        ]
+        for h in hs:
+            eng.synchronize(h)
+    finally:
+        eng.shutdown()
+    for wire, names in batches:
+        wires = {WIRE_INT8 if n == "w.q8" else WIRE_NATIVE for n in names}
+        assert len(wires) == 1, f"mixed wires fused: {batches}"
+        assert wire in wires
+
+
+def test_int8_wire_rejects_non_float_and_non_allreduce():
+    eng = NativeEngine(0, 1, executor=local_executor, cycle_time_ms=20.0)
+    try:
+        with pytest.raises(ValueError, match="floating-point allreduce"):
+            eng.enqueue("bad.int", np.ones(4, np.int32), OP_ALLREDUCE,
+                        wire=WIRE_INT8)
+        with pytest.raises(ValueError, match="floating-point allreduce"):
+            eng.enqueue("bad.op", np.ones(4, np.float32), OP_ALLGATHER,
+                        wire=WIRE_INT8)
+    finally:
+        eng.shutdown()
+
+
+def _worker_wire_mismatch(rank, size, port, q):
+    try:
+        eng = NativeEngine(rank, size, executor=local_executor,
+                           coordinator_host="127.0.0.1",
+                           coordinator_port=port, cycle_time_ms=2.0)
+        x = np.ones(4, np.float32)
+        h = eng.enqueue("badwire", x, OP_ALLREDUCE,
+                        wire=WIRE_INT8 if rank == 0 else WIRE_NATIVE)
+        try:
+            eng.synchronize(h, timeout_s=scaled(30))
+            q.put(("no-error", rank, None))
+        except CollectiveError as e:
+            q.put(("collective-error", rank, str(e)))
+        eng.shutdown()
+    except Exception as e:  # noqa: BLE001
+        q.put(("err", rank, repr(e)))
+
+
+def test_wire_mismatch_error_propagation():
+    """A wire-format disagreement must become a coordinated error on every
+    rank (same contract as dtype mismatches)."""
+    results = _run_spawn(_worker_wire_mismatch)
+    assert {r[0] for r in results} == {"collective-error"}, results
+    assert all("Mismatched wire formats" in r[2] for r in results), results

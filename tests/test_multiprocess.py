@@ -76,6 +76,39 @@ WORKER = PRELUDE + textwrap.dedent("""
     assert out16.dtype == np.float16
     np.testing.assert_allclose(out16.astype(np.float32), np.full(4, float(S)))
 
+    # int8 wire: each rank ships (scale, int8); receiver dequant-sums.
+    # Per-element error <= sum_i scale_i/2; here scale_i = (rank+1)/127.
+    vals = np.linspace(-1.0, 1.0, 8).astype(np.float32) * (rank + 1)
+    h = hvd.allreduce_async(vals, average=False, name="mp.ar.q8",
+                            compression=hvd.Compression.int8)
+    outq = hvd.synchronize(h)
+    assert outq.dtype == np.float32
+    expect = np.linspace(-1.0, 1.0, 8) * S
+    bound = sum((r + 1) / 127.0 / 2 for r in range(n)) + 1e-6
+    assert np.max(np.abs(outq - expect)) <= bound, (outq, expect)
+
+    # Per-TENSOR scales under fusion: a tiny tensor enqueued next to a
+    # huge one (same dtype+wire, so the engine fuses them) must keep its
+    # own quantization grid and survive the wire.
+    h_big = hvd.allreduce_async(np.full(4, 10.0, np.float32),
+                                average=False, name="mp.q8.big",
+                                compression=hvd.Compression.int8)
+    h_tiny = hvd.allreduce_async(np.full(4, 1e-6, np.float32),
+                                 average=False, name="mp.q8.tiny",
+                                 compression=hvd.Compression.int8)
+    big, tiny = hvd.synchronize(h_big), hvd.synchronize(h_tiny)
+    np.testing.assert_allclose(big, np.full(4, 10.0 * n), rtol=0.01)
+    np.testing.assert_allclose(tiny, np.full(4, 1e-6 * n), rtol=0.01)
+    assert np.all(tiny > 0), "tiny tensor was zeroed by a shared scale"
+
+    # Non-finite gradients must not be laundered into finite values.
+    bad = np.ones(4, np.float32)
+    bad[1] = np.nan if rank == 0 else 1.0
+    h = hvd.allreduce_async(bad, average=False, name="mp.q8.nan",
+                            compression=hvd.Compression.int8)
+    outn = hvd.synchronize(h)
+    assert not np.isfinite(outn).all(), "NaN gradient disappeared on wire"
+
     # 64-bit wire exactness: int64/float64 must NOT downcast through the
     # jax transport (byte-view wire, executors._as_wire).
     big = 2 ** 40 + 7  # unrepresentable in float32

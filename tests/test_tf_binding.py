@@ -45,6 +45,14 @@ def test_allreduce_fp16_compression(hvdtf):
     np.testing.assert_allclose(out.numpy(), x.numpy(), atol=1e-2)
 
 
+def test_allreduce_int8_wire(hvdtf):
+    x = tf.linspace(-1.0, 1.0, 8)
+    out = hvdtf.allreduce(x, average=False,
+                          compression=hvd_tf.Compression.int8)
+    assert out.dtype == tf.float32
+    np.testing.assert_allclose(out.numpy(), x.numpy())
+
+
 def test_allreduce_int_average_truncates(hvdtf):
     x = tf.constant([3, 5], tf.int32)
     out = hvdtf.allreduce(x, average=True)

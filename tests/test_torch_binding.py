@@ -45,6 +45,16 @@ def test_allreduce_fp16_compression(hvdt):
     torch.testing.assert_close(out, x, atol=1e-2, rtol=1e-2)
 
 
+def test_allreduce_int8_wire(hvdt):
+    """int8 wire routes through the native engine's quantized path (exact
+    at size 1: the local executor is an identity)."""
+    x = torch.linspace(-1, 1, 8)
+    out = hvdt.allreduce(x, average=False,
+                         compression=hvd_torch.Compression.int8)
+    assert out.dtype == torch.float32
+    torch.testing.assert_close(out, x)
+
+
 def test_allreduce_grad(hvdt):
     x = torch.ones(4, requires_grad=True)
     y = hvdt.allreduce(x, average=True)
