@@ -92,10 +92,13 @@ class _DistributedOptimizer:
                         grad, name=f"DistributedOptimizer.{name}")
                     self._handles[p] = (("sparse", hi, hv), None)
                     return
-            compressed, ctx = self._compression.compress(grad)
-            h = mpi_ops.allreduce_async(compressed, average=True,
-                                        name=f"DistributedOptimizer.{name}")
-            self._handles[p] = (h, ctx)
+            # Forward the compressor to the op layer: wire-format
+            # compressors (Compression.int8) are routed there, not by the
+            # compress() sandwich (which is an identity for them).
+            h = mpi_ops.allreduce_async(grad, average=True,
+                                        name=f"DistributedOptimizer.{name}",
+                                        compression=self._compression)
+            self._handles[p] = (h, None)
         return hook
 
     def synchronize(self):

@@ -171,6 +171,46 @@ WORKER = PRELUDE + textwrap.dedent("""
     for r in range(1, n):
         np.testing.assert_allclose(allw[0], allw[r], atol=1e-6)
 
+    # torch optimizer with int8 gradient compression.  Load-bearing setup:
+    # per-rank init (broadcast must align it), per-rank data (the
+    # allreduce must combine it), and a spy on the engine proving the
+    # int8 wire is actually selected for the optimizer's gradients.
+    from horovod_tpu.core import engine as em
+    seen_wires = []
+    orig_enqueue = em.NativeEngine.enqueue
+
+    def spy(self, name_, array, op, root_rank=-1, wire=em.WIRE_NATIVE):
+        if op == em.OP_ALLREDUCE and "DistributedOptimizer" in name_:
+            seen_wires.append(wire)
+        return orig_enqueue(self, name_, array, op, root_rank, wire)
+
+    em.NativeEngine.enqueue = spy
+    torch.manual_seed(100 + rank)   # different init per rank on purpose
+    model8 = torch.nn.Linear(4, 2)
+    opt8 = hvdt.DistributedOptimizer(
+        torch.optim.SGD(model8.parameters(), lr=0.05),
+        named_parameters=model8.named_parameters(),
+        compression=hvdt.Compression.int8)
+    hvdt.broadcast_parameters(model8.state_dict(), root_rank=0)
+    torch.manual_seed(1000 + rank)  # different data per rank too
+    x8 = torch.randn(8, 4); y8 = torch.randn(8, 2)
+    first = last = None
+    for _ in range(4):
+        opt8.zero_grad()
+        loss = torch.nn.functional.mse_loss(model8(x8), y8)
+        loss.backward()
+        opt8.step()
+        first = loss.item() if first is None else first
+        last = loss.item()
+    em.NativeEngine.enqueue = orig_enqueue
+    assert seen_wires and set(seen_wires) == {em.WIRE_INT8}, seen_wires
+    assert last < first, (first, last)
+    w8 = model8.weight.detach().numpy()
+    h = hvd.allgather_async(w8.reshape(1, -1), name="mp.wcheck8")
+    allw8 = hvd.synchronize(h)
+    for r in range(1, n):
+        np.testing.assert_allclose(allw8[0], allw8[r], atol=1e-6)
+
     # optimizer-state broadcast restores root's values after perturbation
     # (reference test_torch.py:734-866 broadcast_state, :868-935 LR option
     # broadcast): non-root ranks mangle lr and momentum buffers, then the
