@@ -100,48 +100,17 @@ def multihost_executor(engine, batch) -> None:
         flat = np.concatenate([a.ravel() for a in inputs])
         engine.batch_activity(batch, "PROCESS_ALLREDUCE")
         if batch.wire == engine_mod.WIRE_INT8:
-            # int8 wire: each process ships (f32 scale PER TENSOR ‖ int8
-            # values) — ~4x fewer bytes than f32 — and every receiver
-            # dequant-sums the gathered rows in f32.  Scales are per fused
-            # tensor, never per batch: fusion is automatic, and one shared
-            # scale would zero out a small-magnitude tensor (a bias grad)
-            # fused next to a large one.  Local per-rank scales need no
-            # agreement round — the allgather hands us every rank's.
-            # Per-element error <= sum over ranks of scale_{r,t}/2.
-            nt = len(inputs)
-            sizes = [a.size for a in inputs]
-            scales = np.empty(nt, np.float32)
-            qs = []
-            for t, a in enumerate(inputs):
-                f32 = np.asarray(a, np.float32).ravel()
-                amax = float(np.max(np.abs(f32))) if f32.size else 0.0
-                if not np.isfinite(amax):
-                    # Non-finite gradients must stay visible to overflow
-                    # checks: ship q=0 under the non-finite scale so the
-                    # whole tensor dequantizes to NaN (inf*0/nan*0 = nan)
-                    # instead of being laundered into finite garbage.
-                    scales[t] = amax
-                    qs.append(np.zeros(f32.size, np.int8))
-                    continue
-                s = max(amax / 127.0, float(np.finfo(np.float32).tiny))
-                scales[t] = s
-                qs.append(np.clip(np.round(f32 / s), -127,
-                                  127).astype(np.int8))
-            payload = np.concatenate(
-                [scales.view(np.uint8)] + [q.view(np.uint8) for q in qs])
+            # int8 wire (core/qwire.py payload): ~4x fewer bytes than f32;
+            # local per-rank scales need no agreement round — the allgather
+            # hands every receiver every rank's scales.
+            from horovod_tpu.core import qwire
+
+            payload, _, _ = qwire.pack_int8(inputs)
             gathered = multihost_utils.process_allgather(
                 jnp.asarray(payload)[None], tiled=False)
             rows = np.asarray(gathered).reshape(size, -1)
-            acc = np.zeros(flat.size, np.float32)
-            hdr = 4 * nt
-            for r in range(size):
-                s_r = rows[r, :hdr].copy().view(np.float32)
-                data_r = rows[r, hdr:].view(np.int8).astype(np.float32)
-                off = 0
-                for t, n_t in enumerate(sizes):
-                    acc[off:off + n_t] += s_r[t] * data_r[off:off + n_t]
-                    off += n_t
-            summed = acc.astype(flat.dtype)
+            summed = qwire.unpack_sum_int8(
+                rows, [a.size for a in inputs]).astype(flat.dtype)
         else:
             wire, dtype = _as_wire(flat)
             gathered = multihost_utils.process_allgather(

@@ -1,0 +1,56 @@
+"""int8 wire payload codec — shared by the engine executor and the eager op.
+
+One rank's contribution to an int8-wire allreduce is a flat byte payload:
+
+    [f32 scale per tensor ...][int8 values of tensor 0][tensor 1]...
+
+Scales are per TENSOR, never per payload: fusion is automatic, and one
+shared scale would zero out a small-magnitude tensor (a bias gradient)
+packed next to a large one.  Non-finite tensors ship q=0 under their
+non-finite amax so the receiver's dequant-sum produces NaN (inf*0/nan*0)
+instead of laundering the overflow into finite garbage — loss-scaling
+checks keep firing.  Receivers accumulate every rank's payload in f32;
+per-element error is bounded by sum over ranks of scale/2.
+
+Used by core/executors.py (ExecBatch with WireFormat::INT8) and
+ops/collective_ops.py (eager process-level quantized allreduce).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def pack_int8(arrs: list[np.ndarray]) -> tuple[np.ndarray, np.ndarray, list]:
+    """Quantize ``arrs`` into one payload.  Returns (payload_u8, scales, qs);
+    ``scales``/``qs`` let the caller compute its local residual."""
+    nt = len(arrs)
+    scales = np.empty(nt, np.float32)
+    qs = []
+    for t, a in enumerate(arrs):
+        f32 = np.asarray(a, np.float32).ravel()
+        amax = float(np.max(np.abs(f32))) if f32.size else 0.0
+        if not np.isfinite(amax):
+            scales[t] = amax
+            qs.append(np.zeros(f32.size, np.int8))
+            continue
+        s = max(amax / 127.0, float(np.finfo(np.float32).tiny))
+        scales[t] = s
+        qs.append(np.clip(np.round(f32 / s), -127, 127).astype(np.int8))
+    payload = np.concatenate(
+        [scales.view(np.uint8)] + [q.view(np.uint8) for q in qs])
+    return payload, scales, qs
+
+
+def unpack_sum_int8(rows: np.ndarray, sizes: list[int]) -> np.ndarray:
+    """Dequant-sum gathered payload ``rows`` (one per rank) in f32."""
+    hdr = 4 * len(sizes)
+    acc = np.zeros(sum(sizes), np.float32)
+    for r in range(rows.shape[0]):
+        s_r = rows[r, :hdr].copy().view(np.float32)
+        data_r = rows[r, hdr:].view(np.int8).astype(np.float32)
+        off = 0
+        for t, n_t in enumerate(sizes):
+            acc[off:off + n_t] += s_r[t] * data_r[off:off + n_t]
+            off += n_t
+    return acc

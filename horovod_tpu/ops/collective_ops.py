@@ -186,7 +186,7 @@ def quantized_grouped_allreduce(tensors: Sequence, errors: Sequence | None = Non
                                 ) -> tuple[list, list]:
     """Fused allreduce on an int8 wire with a shared scale — 4x fewer bytes
     than float32 (beyond the reference's cast-based Compression, reference
-    compression.py:42-63).  In-mesh only.
+    compression.py:42-63).
 
     Per flat bucket: a scalar ``pmax`` agrees the scale across chips, values
     quantize to at most ``±floor(127/width)`` levels so the int8 ``psum``
@@ -195,17 +195,21 @@ def quantized_grouped_allreduce(tensors: Sequence, errors: Sequence | None = Non
     be passed back on the next call (added to the fresh gradients), so the
     lost precision re-enters instead of biasing training —
     ``DistributedOptimizer(compression=Compression.int8)`` manages this
-    automatically.
+    automatically.  Works in both calling contexts: in-mesh (shared-scale
+    sum-fitting int8 psum) and eager/process-level (per-rank (scale, int8)
+    payloads over the process allgather — core/qwire.py).
 
     Returns ``(reduced, residuals)``, both lists matching ``tensors``.
     """
     axes = _in_mesh_axes()
     if axes is None:
-        raise NotImplementedError(
-            "int8 quantized allreduce is a compiled-path feature: call it "
-            "inside a step wrapped by horovod_tpu.shard (the eager/process "
-            "path wires through f32 staging already; use Compression.fp16/"
-            "bf16 there).")
+        # Eager/process-level: per-rank local scales over the process
+        # allgather — the same (scale ‖ int8) payload as the native
+        # engine's WIRE_INT8 (core/qwire.py).  Error feedback works here
+        # too: residuals are returned and ``errors`` re-enter.
+        _require_not_traced("quantized_grouped_allreduce")
+        return _eager_quantized_reduce(list(tensors), errors,
+                                       average=average)
     width = _data_width(axes)
     if width > 127:
         raise ValueError(
@@ -270,6 +274,44 @@ def grouped_allreduce(tensors: Sequence, average: bool = True,
     if average:
         reduced = [r / denom for r in reduced]
     return [compression.decompress(r, ctx) for r, (_, ctx) in zip(reduced, comp)]
+
+
+def _eager_quantized_reduce(tensors, errors, average: bool):
+    """Process-level int8 allreduce over the shared payload codec
+    (core/qwire.py).  Returns ``(reduced, residuals)`` in each input's own
+    dtype, with the local quantization error as the residual."""
+    from horovod_tpu.core import qwire
+
+    size = basics.size()
+    arrs = [np.asarray(t) for t in tensors]
+    for a in arrs:
+        if a.dtype.kind != "f" and a.dtype.name != "bfloat16":
+            raise ValueError(
+                f"int8 quantization applies to floating gradients, got "
+                f"{a.dtype}")
+    if errors is not None:
+        arrs = [a + np.asarray(e).astype(a.dtype)
+                for a, e in zip(arrs, errors)]
+    sizes = [a.size for a in arrs]
+    payload, scales, qs = qwire.pack_int8(arrs)
+    if size == 1:
+        rows = payload[None]
+    else:
+        rows = np.asarray(multihost_utils.process_allgather(
+            jnp.asarray(payload)[None], tiled=False)).reshape(size, -1)
+    acc = qwire.unpack_sum_int8(rows, sizes)
+    if average:
+        acc = acc / size
+    reduced, resid, off = [], [], 0
+    for t, a in enumerate(arrs):
+        n_t = sizes[t]
+        reduced.append(jnp.asarray(
+            acc[off:off + n_t].astype(a.dtype).reshape(a.shape)))
+        local = np.asarray(a, np.float32).ravel() \
+            - scales[t] * qs[t].astype(np.float32)
+        resid.append(jnp.asarray(local.astype(a.dtype).reshape(a.shape)))
+        off += n_t
+    return reduced, resid
 
 
 def _eager_process_reduce(x):

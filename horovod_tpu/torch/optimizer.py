@@ -90,7 +90,7 @@ class _DistributedOptimizer:
                 else:
                     hi, hv = mpi_ops.allreduce_sparse_async(
                         grad, name=f"DistributedOptimizer.{name}")
-                    self._handles[p] = (("sparse", hi, hv), None)
+                    self._handles[p] = ("sparse", hi, hv)
                     return
             # Forward the compressor to the op layer: wire-format
             # compressors (Compression.int8) are routed there, not by the
@@ -98,19 +98,20 @@ class _DistributedOptimizer:
             h = mpi_ops.allreduce_async(grad, average=True,
                                         name=f"DistributedOptimizer.{name}",
                                         compression=self._compression)
-            self._handles[p] = (h, None)
+            self._handles[p] = h
         return hook
 
     def synchronize(self):
         """Drain outstanding allreduces into ``.grad`` (reference
         torch/__init__.py:99-108)."""
-        for p, (h, ctx) in list(self._handles.items()):
+        for p, h in list(self._handles.items()):
             if isinstance(h, tuple) and h[0] == "sparse":
                 _, hi, hv = h
                 p.grad = mpi_ops.synchronize_sparse(hi, hv, p.shape,
                                                     average=True)
                 continue
-            out = self._compression.decompress(mpi_ops.synchronize(h), ctx)
+            # mpi_ops.synchronize already ran the compressor's decompress.
+            out = mpi_ops.synchronize(h)
             with torch.no_grad():
                 p.grad.copy_(out)
         self._handles.clear()

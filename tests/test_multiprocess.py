@@ -109,6 +109,15 @@ WORKER = PRELUDE + textwrap.dedent("""
     outn = hvd.synchronize(h)
     assert not np.isfinite(outn).all(), "NaN gradient disappeared on wire"
 
+    # Eager (non-engine) quantized path across processes: constant tensors
+    # sit exactly on their own quantization grid, so the sum is exact.
+    from horovod_tpu.ops import quantized_grouped_allreduce as qgar
+    (rq,), (eq,) = qgar([np.full(4, float(rank + 1), np.float32)],
+                        average=False)
+    np.testing.assert_allclose(np.asarray(rq), np.full(4, float(S)),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(eq), np.zeros(4), atol=1e-7)
+
     # 64-bit wire exactness: int64/float64 must NOT downcast through the
     # jax transport (byte-view wire, executors._as_wire).
     big = 2 ** 40 + 7  # unrepresentable in float32

@@ -161,9 +161,19 @@ def test_int8_compressor_rejects_cast_use(hvd):
         hvd.Compression.int8.compress(jnp.ones(3))
 
 
-def test_quantized_eager_raises(hvd):
-    with pytest.raises(NotImplementedError, match="compiled-path"):
-        quantized_grouped_allreduce([jnp.ones(3)])
+def test_quantized_eager_process_level(hvd):
+    """Eager (no mesh axis bound) routes through the process-level
+    (scale, int8) payload path — single process: dequantized round-trip
+    within the quantization grid, residual = the local error."""
+    vals = jnp.asarray(np.linspace(-1, 1, 9).astype(np.float32))
+    (r,), (e,) = quantized_grouped_allreduce([vals], average=False)
+    scale = 1.0 / 127.0
+    np.testing.assert_allclose(np.asarray(r), np.asarray(vals),
+                               atol=scale / 2 + 1e-7)
+    np.testing.assert_allclose(np.asarray(r) + np.asarray(e),
+                               np.asarray(vals), atol=1e-6)
+    with pytest.raises(ValueError, match="floating"):
+        quantized_grouped_allreduce([jnp.ones(3, jnp.int32)])
 
 
 def test_quantized_hierarchical_on_dcn_ici_mesh(hvd):
