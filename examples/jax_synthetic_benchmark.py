@@ -80,7 +80,9 @@ def run(args, threshold: int | None = None) -> float:
     has_stats = "batch_stats" in variables
     batch_stats = ({"batch_stats": variables["batch_stats"]}
                    if has_stats else {})
-    opt = hvd.DistributedOptimizer(optax.sgd(0.01, momentum=0.9))
+    opt = hvd.DistributedOptimizer(
+        optax.sgd(0.01, momentum=0.9),
+        compression=getattr(hvd.Compression, args.compression))
     opt_state = opt.init(params)
     step = build_step(model, opt, args.steps_per_call)
 
@@ -145,6 +147,12 @@ def main():
     ap.add_argument("--num-batches-per-iter", type=int, default=10)
     ap.add_argument("--sweep", action="store_true",
                     help="sweep HOROVOD_FUSION_THRESHOLD")
+    ap.add_argument("--compression", default="none",
+                    choices=("none", "fp16", "bf16", "int8"),
+                    help="gradient wire compression (int8 = shared-scale "
+                         "quantization with error feedback; effects show "
+                         "on multi-chip meshes where collectives move "
+                         "bytes)")
     args = ap.parse_args()
     hvd.init()
     if args.sweep:

@@ -28,7 +28,8 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
     Sparse gradients (``nn.Embedding(sparse=True)``) are routed through the
     gather-based sparse allreduce automatically; ``sparse_as_dense=True``
     densifies them first instead (the reference's escape hatch,
-    tensorflow/__init__.py:197-199)."""
+    tensorflow/__init__.py:197-199).  ``compression`` applies to dense
+    gradients only — the sparse gather path always ships native dtypes."""
     return _DistributedOptimizer(optimizer, named_parameters, compression,
                                  backward_passes_per_step, sparse_as_dense)
 

@@ -255,3 +255,33 @@ def test_single_allreduce_int8_routes_to_quantized(hvd):
     qcap = max(127 // n, 1)
     scale = np.abs(vals).max() / qcap
     np.testing.assert_allclose(got, vals.mean(axis=0), atol=scale / 2 + 1e-7)
+
+
+def test_int8_ef_state_checkpoints(hvd, tmp_path):
+    """DistributedEFState (inner + error residual) must round-trip through
+    the checkpoint layer like any optimizer state — resuming an int8 run
+    keeps its error feedback."""
+    from horovod_tpu import checkpoint
+
+    opt = hvd.DistributedOptimizer(optax.sgd(0.1),
+                                   compression=hvd.Compression.int8)
+    params = {"w": jnp.ones((4,))}
+    state = opt.init(params)
+
+    @jax.jit
+    @hvd.shard(in_specs=(P(), P()), out_specs=(P(), P()))
+    def one(params, state):
+        grads = {"w": jnp.asarray([0.3, -0.7, 0.5, 0.01])}
+        updates, state = opt.update(grads, state, params)
+        return updates, state
+
+    _, state = one(params, state)
+    checkpoint.save(tmp_path / "ef", state)
+    # Restore into a ZEROED template: values must come from disk, not be
+    # the template handed back.
+    zeros = jax.tree.map(jnp.zeros_like, state)
+    restored = checkpoint.restore(tmp_path / "ef", template=zeros)
+    assert isinstance(restored, DistributedEFState)
+    assert np.abs(np.asarray(state.error["w"])).sum() > 0
+    np.testing.assert_allclose(np.asarray(restored.error["w"]),
+                               np.asarray(state.error["w"]), atol=1e-7)
