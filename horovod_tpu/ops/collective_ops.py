@@ -184,20 +184,24 @@ def quantized_grouped_allreduce(tensors: Sequence, errors: Sequence | None = Non
                                 average: bool = True,
                                 threshold_bytes: int | None = None
                                 ) -> tuple[list, list]:
-    """Fused allreduce on an int8 wire with a shared scale — 4x fewer bytes
-    than float32 (beyond the reference's cast-based Compression, reference
+    """Fused allreduce on an int8 wire — 4x fewer bytes than float32
+    (beyond the reference's cast-based Compression, reference
     compression.py:42-63).
 
-    Per flat bucket: a scalar ``pmax`` agrees the scale across chips, values
-    quantize to at most ``±floor(127/width)`` levels so the int8 ``psum``
-    cannot overflow, and the sum dequantizes back.  ``errors`` carries error
-    feedback: each chip's local quantization residual is returned and should
-    be passed back on the next call (added to the fresh gradients), so the
-    lost precision re-enters instead of biasing training —
+    Scales are agreed per TENSOR (one stacked ``pmax`` covers all of them),
+    never per fused bucket — a bias gradient packed next to a large logits
+    gradient keeps its own quantization grid instead of rounding to zero.
+    Values quantize to at most ``±floor(127/width)`` levels so the int8
+    ``psum`` cannot overflow at any partial sum, and the sum dequantizes
+    back.  ``errors`` carries error feedback: each chip's local
+    quantization residual is returned and should be passed back on the
+    next call (added to the fresh gradients), so the lost precision
+    re-enters instead of biasing training —
     ``DistributedOptimizer(compression=Compression.int8)`` manages this
-    automatically.  Works in both calling contexts: in-mesh (shared-scale
-    sum-fitting int8 psum) and eager/process-level (per-rank (scale, int8)
-    payloads over the process allgather — core/qwire.py).
+    automatically.  Works in both calling contexts: in-mesh (sum-fitting
+    int8 psum, hierarchical on (dcn, ici) meshes) and eager/process-level
+    (per-rank (scale, int8) payloads over the process allgather —
+    core/qwire.py).
 
     Returns ``(reduced, residuals)``, both lists matching ``tensors``.
     """
@@ -225,23 +229,48 @@ def quantized_grouped_allreduce(tensors: Sequence, errors: Sequence | None = Non
     if errors is not None:
         tensors = [t + e.astype(t.dtype) for t, e in zip(tensors, errors)]
 
-    def qreduce(flat):
-        amax = lax.pmax(jnp.max(jnp.abs(flat)), axes)
-        # Guard in the working dtype: an f32-tiny floor would underflow to 0
-        # after an fp16/bf16 cast, turning all-zero buckets into 0/0 = NaN.
-        scale = jnp.maximum(amax.astype(flat.dtype) / qcap,
-                            jnp.finfo(flat.dtype).tiny)
-        q = jnp.clip(jnp.round(flat / scale), -qcap, qcap).astype(jnp.int8)
-        # |any partial or total sum| <= width*qcap <= 127: no int8 overflow,
-        # including the hierarchical ICI-scatter -> DCN -> ICI-gather route
-        # (the int8 shard is what crosses DCN — the bandwidth win compounds).
-        summed = _mesh_allreduce(q, axes)
-        deq = q.astype(flat.dtype) * scale
-        return summed.astype(flat.dtype) * scale, flat - deq
+    # One collective agrees every tensor's scale: stack the local amaxes
+    # into a vector and pmax it.  Non-finite local amaxes are sanitized to
+    # +inf FIRST — XLA's max has IEEE maxNum semantics and would silently
+    # drop a NaN operand, laundering an overflowed gradient into a finite
+    # reduced value.
+    local_amax = jnp.stack([
+        (jnp.max(jnp.abs(t)) if t.size else jnp.zeros((), t.dtype))
+        .astype(jnp.float32)
+        for t in tensors])
+    local_amax = jnp.where(jnp.isfinite(local_amax), local_amax, jnp.inf)
+    amaxes = lax.pmax(local_amax, axes)
+    qs, scales, resid = [], [], []
+    for i, t in enumerate(tensors):
+        # Guard in the working dtype: an f32-tiny floor would underflow to
+        # 0 after an fp16/bf16 cast, turning all-zero tensors into 0/0=NaN.
+        finite = jnp.isfinite(amaxes[i])
+        scale = jnp.where(
+            finite,
+            jnp.maximum(amaxes[i].astype(t.dtype) / qcap,
+                        jnp.finfo(t.dtype).tiny),
+            amaxes[i].astype(t.dtype))
+        # Non-finite gradients ship q=0 under the inf scale so the
+        # dequantized tensor is NaN (inf*0) on EVERY chip — overflow
+        # checks keep firing instead of seeing laundered finite values.
+        q = jnp.where(finite,
+                      jnp.clip(jnp.round(t / scale), -qcap, qcap),
+                      jnp.zeros_like(t)).astype(jnp.int8)
+        qs.append(q)
+        scales.append(scale)
+        # Residual resets on a non-finite step: carrying a NaN residual
+        # would poison error feedback long after the loss-scaler recovers.
+        resid.append(jnp.where(finite, t - q.astype(t.dtype) * scale,
+                               jnp.zeros_like(t)))
 
-    reduced, resid = fusion.fused_apply_multi(tensors, qreduce, threshold_bytes)
-    if average:
-        reduced = [r / width for r in reduced]
+    # |any partial or total sum| <= width*qcap <= 127: no int8 overflow,
+    # including the hierarchical ICI-scatter -> DCN -> ICI-gather route
+    # (the int8 shard is what crosses DCN — the bandwidth win compounds).
+    summed = fusion.fused_apply(qs, lambda flat: _mesh_allreduce(flat, axes),
+                                threshold_bytes)
+    inv = (1.0 / width) if average else 1.0
+    reduced = [s.astype(t.dtype) * scales[i] * inv
+               for i, (s, t) in enumerate(zip(summed, tensors))]
     return reduced, resid
 
 
@@ -307,8 +336,13 @@ def _eager_quantized_reduce(tensors, errors, average: bool):
         n_t = sizes[t]
         reduced.append(jnp.asarray(
             acc[off:off + n_t].astype(a.dtype).reshape(a.shape)))
-        local = np.asarray(a, np.float32).ravel() \
-            - scales[t] * qs[t].astype(np.float32)
+        if np.isfinite(scales[t]):
+            local = np.asarray(a, np.float32).ravel() \
+                - scales[t] * qs[t].astype(np.float32)
+        else:
+            # Residual resets on a non-finite step (see in-mesh path): a
+            # NaN residual would poison error feedback indefinitely.
+            local = np.zeros(n_t, np.float32)
         resid.append(jnp.asarray(local.astype(a.dtype).reshape(a.shape)))
         off += n_t
     return reduced, resid

@@ -285,3 +285,53 @@ def test_int8_ef_state_checkpoints(hvd, tmp_path):
     assert np.abs(np.asarray(state.error["w"])).sum() > 0
     np.testing.assert_allclose(np.asarray(restored.error["w"]),
                                np.asarray(state.error["w"]), atol=1e-7)
+
+
+def test_quantized_per_tensor_scales_in_mesh(hvd):
+    """Compiled path: a tiny tensor grouped with a huge one keeps its own
+    quantization grid (per-tensor scales, not per fused bucket)."""
+    n = hvd.num_chips()
+
+    @_chipwise
+    def reduce_two(x):
+        big = jnp.full(4, 10.0) * (x[0, 0] * 0 + 1)   # shard-dependent noop
+        tiny = jnp.full(4, 1e-6) * (x[0, 0] * 0 + 1)
+        (rb, rt), _ = quantized_grouped_allreduce([big, tiny], average=False)
+        return jnp.stack([rb, rt])
+
+    out = np.asarray(reduce_two(jnp.ones((n, 2), jnp.float32)))
+    np.testing.assert_allclose(out[0], np.full(4, 10.0 * n), rtol=0.01)
+    np.testing.assert_allclose(out[1], np.full(4, 1e-6 * n), rtol=0.01)
+    assert np.all(out[1] > 0), "tiny tensor zeroed by a shared bucket scale"
+
+
+def test_quantized_nonfinite_propagates_in_mesh(hvd):
+    """Compiled path: a NaN gradient must dequantize to NaN, not finite."""
+    n = hvd.num_chips()
+
+    @_chipwise
+    def reduce_nan(x):
+        bad = jnp.ones(4) * x[0, 0]   # x carries the NaN in shard 0
+        (r,), _ = quantized_grouped_allreduce([bad], average=False)
+        return r
+
+    x = np.ones((n, 2), np.float32)
+    x[0, 0] = np.nan
+    out = np.asarray(reduce_nan(jnp.asarray(x)))
+    assert not np.isfinite(out).all(), out
+
+
+def test_quantized_empty_tensor_in_mesh(hvd):
+    """Zero-size leaves (an empty head) must not crash the per-tensor amax."""
+    n = hvd.num_chips()
+
+    @_chipwise
+    def reduce_with_empty(x):
+        full = jnp.ones(4) * x[0, 0]
+        empty = jnp.zeros((0,), jnp.float32)
+        (rf, re), _ = quantized_grouped_allreduce([full, empty],
+                                                  average=False)
+        return rf
+
+    out = np.asarray(reduce_with_empty(jnp.ones((n, 2), jnp.float32)))
+    np.testing.assert_allclose(out, np.full(4, float(n)), rtol=1e-6)
