@@ -1,10 +1,10 @@
-"""int8 quantized allreduce with shared scale + error feedback.
+"""int8 quantized allreduce with per-tensor pmax scales + error feedback.
 
 Beyond the reference's cast-based Compression pair (reference
 compression.py:42-63): the wire carries int8 (4x smaller than float32),
-correctness comes from a pmax-agreed scale with a sum-fitting range, and
-``DistributedOptimizer(compression=Compression.int8)`` carries the
-quantization residual as error feedback.
+correctness comes from per-tensor pmax-agreed scales with a sum-fitting
+range, and ``DistributedOptimizer(compression=Compression.int8)`` carries
+the quantization residual as error feedback.
 """
 
 import jax
