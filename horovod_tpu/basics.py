@@ -174,6 +174,9 @@ def shutdown() -> None:
     from horovod_tpu.core import engine as _engine
 
     _engine.shutdown_engine()
+    from horovod_tpu.core import device_reduce as _device_reduce
+
+    _device_reduce.reset()
     from horovod_tpu import mesh as _mesh
 
     _mesh.reset()

@@ -11,10 +11,14 @@ Executors:
 * ``local``    — single-process jobs (the common TPU case): collectives over
   one process are identities; fusion/ordering/handles still exercise the
   full native path.
-* ``multihost`` — multi-process jobs: flat fused buffer through
-  ``jax.experimental.multihost_utils`` (allgather+sum = allreduce), riding
-  DCN/ICI via the jax.distributed client.  Requires identical batch order on
-  every process — exactly what the coordinator guarantees.
+* ``multihost`` — multi-process jobs: flat fused buffer reduced on device
+  by reduce-scatter -> allgather over a one-device-per-process mesh
+  (core/device_reduce.py; ~2n wire bytes per rank, the MPI-ring number the
+  reference gets from MPI_Allreduce, reference operations.cc:1242-1268),
+  riding DCN/ICI via the jax.distributed client.  Requires identical batch
+  order on every process — exactly what the coordinator guarantees.
+  8-byte dtypes (not device-representable without x64) and
+  ``HVD_TPU_EAGER_REDUCE=gather`` fall back to allgather+host-sum.
 
 Select with ``HVD_TPU_EXECUTOR`` (local|multihost); default picks by size.
 """
@@ -99,18 +103,32 @@ def multihost_executor(engine, batch) -> None:
         engine.batch_activity(batch, "MEMCPY_IN_FUSION_BUFFER")
         flat = np.concatenate([a.ravel() for a in inputs])
         engine.batch_activity(batch, "PROCESS_ALLREDUCE")
+        from horovod_tpu.core import device_reduce
+
         if batch.wire == engine_mod.WIRE_INT8:
-            # int8 wire (core/qwire.py payload): ~4x fewer bytes than f32;
-            # local per-rank scales need no agreement round — the allgather
-            # hands every receiver every rank's scales.
+            # int8 wire (core/qwire.py): ~4x fewer bytes than f32; local
+            # per-rank scales need no agreement round.
             from horovod_tpu.core import qwire
 
-            payload, _, _ = qwire.pack_int8(inputs)
-            gathered = multihost_utils.process_allgather(
-                jnp.asarray(payload)[None], tiled=False)
-            rows = np.asarray(gathered).reshape(size, -1)
-            summed = qwire.unpack_sum_int8(
-                rows, [a.size for a in inputs]).astype(flat.dtype)
+            if device_reduce.enabled():
+                # Device route: int8 chunks reduce-scatter, dequant-sum on
+                # device, int8 return leg (~2n wire bytes total).
+                scales, qs = qwire.quantize_int8(inputs)
+                summed = device_reduce.process_allreduce_int8(
+                    scales, qs, [a.size for a in inputs]).astype(flat.dtype)
+            else:
+                # Legacy: payload allgather + host dequant-sum loop.
+                payload, _, _ = qwire.pack_int8(inputs)
+                gathered = multihost_utils.process_allgather(
+                    jnp.asarray(payload)[None], tiled=False)
+                rows = np.asarray(gathered).reshape(size, -1)
+                summed = qwire.unpack_sum_int8(
+                    rows, [a.size for a in inputs]).astype(flat.dtype)
+        elif device_reduce.enabled() and flat.dtype.itemsize != 8:
+            # Reduce-scatter -> allgather on device; half-precision wires
+            # accumulate in f32 inside the compiled reducer (half.cc
+            # staging semantics with the reduction on device).
+            summed = device_reduce.process_allreduce(flat)
         else:
             wire, dtype = _as_wire(flat)
             gathered = multihost_utils.process_allgather(

@@ -21,9 +21,9 @@ from __future__ import annotations
 import numpy as np
 
 
-def pack_int8(arrs: list[np.ndarray]) -> tuple[np.ndarray, np.ndarray, list]:
-    """Quantize ``arrs`` into one payload.  Returns (payload_u8, scales, qs);
-    ``scales``/``qs`` let the caller compute its local residual."""
+def quantize_int8(arrs: list[np.ndarray]) -> tuple[np.ndarray, list]:
+    """Per-tensor quantization of ``arrs``.  Returns (scales, qs); the
+    caller's local residual is ``a - scales[t] * qs[t]``."""
     nt = len(arrs)
     scales = np.empty(nt, np.float32)
     qs = []
@@ -37,13 +37,25 @@ def pack_int8(arrs: list[np.ndarray]) -> tuple[np.ndarray, np.ndarray, list]:
         s = max(amax / 127.0, float(np.finfo(np.float32).tiny))
         scales[t] = s
         qs.append(np.clip(np.round(f32 / s), -127, 127).astype(np.int8))
+    return scales, qs
+
+
+def pack_int8(arrs: list[np.ndarray]) -> tuple[np.ndarray, np.ndarray, list]:
+    """Quantize ``arrs`` into one payload.  Returns (payload_u8, scales, qs);
+    ``scales``/``qs`` let the caller compute its local residual."""
+    scales, qs = quantize_int8(arrs)
     payload = np.concatenate(
         [scales.view(np.uint8)] + [q.view(np.uint8) for q in qs])
     return payload, scales, qs
 
 
 def unpack_sum_int8(rows: np.ndarray, sizes: list[int]) -> np.ndarray:
-    """Dequant-sum gathered payload ``rows`` (one per rank) in f32."""
+    """Dequant-sum gathered payload ``rows`` (one per rank) in f32.
+
+    Legacy/fallback host reducer: the default data plane dequant-sums on
+    device via the reduce-scatter route (core/device_reduce.py
+    ``process_allreduce_int8``); this remains for single-process jobs and
+    ``HVD_TPU_EAGER_REDUCE=gather``."""
     hdr = 4 * len(sizes)
     acc = np.zeros(sum(sizes), np.float32)
     for r in range(rows.shape[0]):

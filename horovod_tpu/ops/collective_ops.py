@@ -269,7 +269,12 @@ def quantized_grouped_allreduce(tensors: Sequence, errors: Sequence | None = Non
     summed = fusion.fused_apply(qs, lambda flat: _mesh_allreduce(flat, axes),
                                 threshold_bytes)
     inv = (1.0 / width) if average else 1.0
-    reduced = [s.astype(t.dtype) * scales[i] * inv
+    # Dequantize in f32: for fp16 gradients the intermediate sum (up to
+    # width*amax) can overflow to inf in the gradient dtype even when the
+    # averaged result is representable, so fold the average into the scale
+    # and multiply in f32 before casting back.
+    reduced = [(s.astype(jnp.float32)
+                * (scales[i].astype(jnp.float32) * inv)).astype(t.dtype)
                for i, (s, t) in enumerate(zip(summed, tensors))]
     return reduced, resid
 
@@ -322,13 +327,22 @@ def _eager_quantized_reduce(tensors, errors, average: bool):
         arrs = [a + np.asarray(e).astype(a.dtype)
                 for a, e in zip(arrs, errors)]
     sizes = [a.size for a in arrs]
-    payload, scales, qs = qwire.pack_int8(arrs)
-    if size == 1:
-        rows = payload[None]
+    from horovod_tpu.core import device_reduce
+
+    if size > 1 and device_reduce.enabled():
+        # Device route: int8 reduce-scatter + on-device dequant-sum +
+        # requantized int8 return leg (~2n wire bytes; see
+        # core/device_reduce.py for the error model).
+        scales, qs = qwire.quantize_int8(arrs)
+        acc = device_reduce.process_allreduce_int8(scales, qs, sizes)
     else:
-        rows = np.asarray(multihost_utils.process_allgather(
-            jnp.asarray(payload)[None], tiled=False)).reshape(size, -1)
-    acc = qwire.unpack_sum_int8(rows, sizes)
+        payload, scales, qs = qwire.pack_int8(arrs)
+        if size == 1:
+            rows = payload[None]
+        else:
+            rows = np.asarray(multihost_utils.process_allgather(
+                jnp.asarray(payload)[None], tiled=False)).reshape(size, -1)
+        acc = qwire.unpack_sum_int8(rows, sizes)
     if average:
         acc = acc / size
     reduced, resid, off = [], [], 0
@@ -351,6 +365,20 @@ def _eager_quantized_reduce(tensors, errors, average: bool):
 def _eager_process_reduce(x):
     if basics.size() == 1:
         return jnp.asarray(x)
+    from horovod_tpu.core import device_reduce
+
+    arr = np.asarray(x)
+    # Floating dtypes only: the legacy path's jnp.sum PROMOTES small ints
+    # and bool to int32 results, a public-API behavior the device reducer
+    # (which keeps the input dtype) must not silently change; integer eager
+    # reductions are metric-sized, so the gather path costs nothing.
+    floating = arr.dtype.kind == "f" or arr.dtype.name == "bfloat16"
+    if device_reduce.enabled() and floating and arr.dtype.itemsize != 8:
+        # Reduce-scatter -> allgather on device (~2n wire bytes per rank,
+        # core/device_reduce.py) — the reference's MPI_Allreduce ring
+        # economics instead of allgather+host-sum.
+        return jnp.asarray(
+            device_reduce.process_allreduce(arr.ravel()).reshape(arr.shape))
     gathered = multihost_utils.process_allgather(jnp.asarray(x)[None], tiled=False)
     return jnp.sum(gathered.reshape((basics.size(),) + jnp.shape(x)), axis=0)
 

@@ -1,0 +1,112 @@
+"""Wire-byte accounting for the eager data plane (VERDICT r2 item 1).
+
+The reference's eager allreduce inherits MPI's ring economics: ~2n wire
+bytes per rank regardless of job size (reference operations.cc:1242-1268).
+Round 2's allgather+host-sum moved (P-1)*n per rank instead.  This
+microbench measures REAL loopback traffic (/proc/net/dev) for a 4-process
+job in both modes and asserts the device reduce-scatter route
+(core/device_reduce.py) cuts wire bytes by ~P/2 = 2x, for the dense f32
+wire and the int8 wire alike.
+
+Accounting model (total rx across all ranks, K iterations of n bytes):
+  gather:  P*(P-1)*n*K      device:  2*(P-1)*n*K      ratio: P/2
+"""
+
+import os
+import subprocess
+
+import pytest
+
+from _timing import scaled
+from test_multiprocess import PRELUDE, _run_workers_once
+
+NPROCS = 4
+ELEMS = 1 << 21          # 2 Mi f32 elements = 8 MiB dense, 2 MiB int8 wire
+ITERS = 4
+
+WIRE_WORKER = PRELUDE + """
+import numpy as np
+mode = os.environ["WB_MODE"]
+N = int(os.environ["WB_ELEMS"])
+K = int(os.environ["WB_ITERS"])
+x = (np.random.RandomState(rank).rand(N).astype(np.float32) - 0.5)
+if mode == "dense":
+    for k in range(K):
+        h = hvd.allreduce_async(x, average=False, name=f"wb.{k}")
+        hvd.synchronize(h)
+elif mode == "int8":
+    for k in range(K):
+        h = hvd.allreduce_async(x, average=False, name=f"wbq.{k}",
+                                compression=hvd.Compression.int8)
+        hvd.synchronize(h)
+elif mode == "idle":
+    pass
+else:
+    raise AssertionError(mode)
+# Rendezvous before exit: a rank that exits early tears down the control
+# plane and aborts peers still inside their last synchronize.
+hvd.barrier(name="wb.done")
+print(f"RANK{rank} OK", flush=True)
+"""
+
+
+def _lo_rx_bytes() -> int:
+    with open("/proc/net/dev") as f:
+        for line in f:
+            line = line.strip()
+            if line.startswith("lo:"):
+                return int(line.split(":")[1].split()[0])
+    raise AssertionError("no loopback interface in /proc/net/dev")
+
+
+def _job_bytes(mode: str, algo: str) -> int:
+    """Loopback rx bytes for one 4-process job.  Retries infra noise with a
+    FRESH counter read — a silent whole-job retry under one measurement
+    would double-count traffic and corrupt the ratio assertions."""
+    env = {"WB_MODE": mode, "WB_ELEMS": str(ELEMS), "WB_ITERS": str(ITERS),
+           "HVD_TPU_EAGER_REDUCE": algo}
+    last_err = ""
+    for _attempt in range(2):
+        before = _lo_rx_bytes()
+        try:
+            outs = _run_workers_once(WIRE_WORKER, NPROCS, scaled(300), env)
+        except subprocess.TimeoutExpired:
+            last_err = "job timeout"
+            continue
+        if all(f"RANK{r} OK" in out for r, (out, _) in enumerate(outs)):
+            return _lo_rx_bytes() - before
+        last_err = "\n".join(err[-2000:] for _, err in outs)
+    raise AssertionError(f"wire-byte job {mode}/{algo} failed twice:\n"
+                         f"{last_err}")
+
+
+@pytest.mark.skipif(not os.path.exists("/proc/net/dev"),
+                    reason="needs /proc/net/dev")
+def test_device_reduce_halves_wire_bytes():
+    # Boot/rendezvous overhead measured once and subtracted from each job.
+    overhead = _job_bytes("idle", "device")
+    payload = ELEMS * 4 * ITERS
+    results = {}
+    for mode in ("dense", "int8"):
+        for algo in ("gather", "device"):
+            raw = _job_bytes(mode, algo)
+            results[(mode, algo)] = max(raw - overhead, 1)
+    n_dense, n_int8 = payload, payload // 4
+    expect = {
+        ("dense", "gather"): NPROCS * (NPROCS - 1) * n_dense,
+        ("dense", "device"): 2 * (NPROCS - 1) * n_dense,
+        ("int8", "gather"): NPROCS * (NPROCS - 1) * n_int8,
+        ("int8", "device"): 2 * (NPROCS - 1) * n_int8,
+    }
+    for key, got in results.items():
+        print(f"{key}: measured {got/1e6:.1f} MB, model {expect[key]/1e6:.1f}"
+              f" MB ({got/expect[key]:.2f}x of model)")
+
+    dense_ratio = results[("dense", "gather")] / results[("dense", "device")]
+    int8_ratio = results[("int8", "gather")] / results[("int8", "device")]
+    # Model says P/2 = 2.0; margin for gloo framing + control plane noise.
+    assert dense_ratio >= 1.7, f"dense wire reduction only {dense_ratio:.2f}x"
+    assert int8_ratio >= 1.7, f"int8 wire reduction only {int8_ratio:.2f}x"
+    # int8 wire is ~4x leaner than the dense wire on the same route.
+    comp_ratio = results[("dense", "device")] / results[("int8", "device")]
+    assert comp_ratio >= 2.5, f"int8 compression only {comp_ratio:.2f}x"
