@@ -113,6 +113,13 @@ def main():
             print(f"epoch {epoch}: loss={float(loss):.4f} acc={acc:.3f} "
                   f"({time.time() - t0:.1f}s)")
 
+    # Every rank reports the globally-averaged final metric (identical by
+    # construction — multi-process CI asserts this, tests/test_examples.py).
+    final_loss = float(np.asarray(hvd.allreduce(
+        jnp.asarray(0.0 if loss is None else float(loss)))))
+    print(f"[rank {hvd.rank()}/{hvd.size()}] final loss={final_loss:.6f} "
+          f"acc={acc:.4f}", flush=True)
+
     # Horovod: checkpoint on rank 0 only (reference :108-110).
     hvd.checkpoint.save_epoch(args.ckpt_dir, args.epochs - 1,
                               {"params": params})

@@ -140,6 +140,8 @@ def main():
             "step_ms": round(step_ms, 1),
             "mfu": round(mfu, 4),
             "flash": not args.no_flash,
+            "block_q": args.block_q,
+            "block_k": args.block_k,
         }))
 
 

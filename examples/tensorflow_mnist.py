@@ -83,6 +83,11 @@ def main():
         if hvd.rank() == 0:
             print(f"epoch {epoch}: loss={mean_loss:.4f}")
 
+    # Every rank reports the globally-averaged final metric (identical by
+    # construction — multi-process CI asserts this, tests/test_examples.py).
+    print(f"[rank {hvd.rank()}/{hvd.size()}] final loss={mean_loss:.6f}",
+          flush=True)
+
     if hvd.rank() == 0:
         model.save("/tmp/hvd_tpu_tf_mnist.keras")
         print("saved /tmp/hvd_tpu_tf_mnist.keras")

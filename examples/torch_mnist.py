@@ -119,6 +119,13 @@ def main():
         if hvd.rank() == 0:
             print(f"epoch {epoch}: loss={float(loss):.4f}")
 
+    # Every rank reports the globally-averaged final metric (identical by
+    # construction — multi-process CI asserts this, tests/test_examples.py).
+    final = hvd.allreduce(loss.detach() if loss is not None
+                          else torch.zeros(()), average=True)
+    print(f"[rank {hvd.rank()}/{hvd.size()}] final loss={float(final):.6f}",
+          flush=True)
+
 
 if __name__ == "__main__":
     main()

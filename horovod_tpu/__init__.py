@@ -38,8 +38,8 @@ for _mod, _names in {
     "horovod_tpu.basics": (
         "NotInitializedError", "chips_per_slice", "cross_rank", "cross_size",
         "init", "is_initialized", "local_num_chips", "local_rank",
-        "local_size", "mpi_threads_supported", "num_chips", "rank",
-        "shutdown", "size",
+        "local_size", "member_process_ids", "mpi_threads_supported",
+        "num_chips", "rank", "shutdown", "size", "subset_active",
     ),
     "horovod_tpu.core.engine": ("CollectiveError",),
     "horovod_tpu.mesh": (

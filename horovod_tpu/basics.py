@@ -55,8 +55,8 @@ class NotInitializedError(RuntimeError):
 class Topology:
     """Immutable snapshot of the pod-slice topology taken at ``init()``."""
 
-    rank: int              # this process's index among all processes
-    size: int              # number of processes
+    rank: int              # this process's index among job processes
+    size: int              # number of job processes
     local_rank: int        # index of this process among processes on its host
     local_size: int        # processes on this host (JAX: 1 per host)
     cross_rank: int        # slice index of this process's chips
@@ -64,6 +64,8 @@ class Topology:
     num_chips: int         # total accelerator count (data-parallel width)
     local_num_chips: int   # chips driven by this process
     chips_per_slice: int
+    member_pids: tuple     # jax process indices forming this job (subset or
+                           # all; rank == member_pids.index(process_index))
 
 
 _lock = threading.Lock()
@@ -89,7 +91,8 @@ def _detect_slices(devices) -> tuple[int, int]:
 
 def init(*, distributed: bool | None = None, coordinator_address: str | None = None,
          num_processes: int | None = None, process_id: int | None = None,
-         mesh_axes: dict[str, int] | None = None) -> None:
+         mesh_axes: dict[str, int] | None = None,
+         ranks: list[int] | None = None) -> None:
     """Initialize horovod_tpu — the analog of ``hvd.init()``.
 
     Unlike the reference (which boots MPI, reference operations.cc:1435-1663),
@@ -101,6 +104,18 @@ def init(*, distributed: bool | None = None, coordinator_address: str | None = N
     ``mesh_axes`` adds model-parallel axes (name → size) to the global mesh
     next to the data axis, e.g. ``{"tp": 4}``; data-parallel width becomes
     ``num_chips / prod(mesh_axes)``.
+
+    ``ranks`` restricts the job to a subset of the jax processes — the
+    analog of ``hvd.init(comm=[ranks])`` building a sub-communicator
+    (reference common/__init__.py:58-84, operations.cc:1469-1483): this
+    process's ``rank()`` becomes its position in the list and ``size()``
+    the list length; the global mesh and eager collectives span only the
+    member processes' devices.  Every member must pass the same list.
+    Unlike the reference (which falls back to MPI_COMM_WORLD with a
+    warning), a NON-member calling ``init(ranks=...)`` raises — there is
+    no world communicator to fall back to once the mesh is restricted.
+    Collectives that still require the full jax job under a subset (the
+    legacy ``HVD_TPU_EAGER_REDUCE=gather`` transport) raise clearly.
 
     Safe to call more than once (subsequent calls are no-ops), matching
     ``InitializeHorovodOnce`` (reference operations.cc:1907-1925).
@@ -135,7 +150,26 @@ def init(*, distributed: bool | None = None, coordinator_address: str | None = N
                 # first in a genuinely single-process run.
                 if jax.process_count() == 1 and (num_processes or 1) > 1:
                     raise
-        devices = jax.devices()
+        pid, nproc = jax.process_index(), jax.process_count()
+        if ranks is not None:
+            members = tuple(int(r) for r in ranks)
+            if len(set(members)) != len(members) or not members or any(
+                    r < 0 or r >= nproc for r in members):
+                raise ValueError(
+                    f"init(ranks={list(ranks)}): ranks must be distinct "
+                    f"process indices in [0, {nproc})")
+            if pid not in members:
+                raise ValueError(
+                    f"process {pid} is not in init(ranks={list(ranks)}); "
+                    f"every member passes the same list and non-members "
+                    f"must not init this job (no COMM_WORLD fallback on "
+                    f"the TPU rebuild — the mesh is restricted to members)")
+            rank_, size_ = members.index(pid), len(members)
+        else:
+            members = tuple(range(nproc))
+            rank_, size_ = pid, nproc
+        devices = [d for d in jax.devices()
+                   if getattr(d, "process_index", 0) in set(members)]
         local = jax.local_devices()
         cross_rank, cross_size = _detect_slices(devices)
         # JAX runs one process per host, so the host-local "communicator"
@@ -143,8 +177,8 @@ def init(*, distributed: bool | None = None, coordinator_address: str | None = N
         # node-local rank used for device pinning (N/A on TPU, kept for API
         # parity with reference common/__init__.py:104-121).
         topo = Topology(
-            rank=jax.process_index(),
-            size=jax.process_count(),
+            rank=rank_,
+            size=size_,
             local_rank=0,
             local_size=1,
             cross_rank=cross_rank,
@@ -152,13 +186,15 @@ def init(*, distributed: bool | None = None, coordinator_address: str | None = N
             num_chips=len(devices),
             local_num_chips=len(local),
             chips_per_slice=max(len(devices) // max(cross_size, 1), 1),
+            member_pids=members,
         )
         # Build the global mesh BEFORE publishing topology so a mesh failure
         # leaves the process cleanly un-initialized (re-init can retry);
         # mirrors comm setup at reference operations.cc:1484-1532.
         from horovod_tpu import mesh as _mesh
 
-        _mesh.build_global_mesh(mesh_axes, cross_size=cross_size)
+        _mesh.build_global_mesh(mesh_axes, cross_size=cross_size,
+                                devices=devices)
         _topology = topo
     atexit.register(shutdown)  # reference common/__init__.py:69
 
@@ -231,6 +267,21 @@ def local_num_chips() -> int:
 
 def chips_per_slice() -> int:
     return _topo().chips_per_slice
+
+
+def member_process_ids() -> tuple:
+    """jax process indices forming this job (all processes unless
+    ``init(ranks=...)`` restricted it); this process's ``rank()`` is its
+    position here."""
+    return _topo().member_pids
+
+
+def subset_active() -> bool:
+    """True when ``init(ranks=...)`` restricted the job to a process subset."""
+    t = _topo()
+    import jax
+
+    return len(t.member_pids) != jax.process_count()
 
 
 def mpi_threads_supported() -> bool:

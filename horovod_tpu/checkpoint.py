@@ -103,12 +103,42 @@ def save(path: str | os.PathLike, state: Any, *, force: bool = True,
     if basics.rank() != 0:
         return
     path = os.path.abspath(os.fspath(path))
+    # Rank-0-only writes (the reference contract) use a LONE-process orbax
+    # checkpointer, so multi-process global arrays must come to host first:
+    # replicated arrays (the DP case — params/optimizer state out of
+    # hvd.shard) read their local copy; genuinely cross-process-sharded
+    # arrays cannot be written by one rank — fail with direction.
+    def _to_host(v):
+        if isinstance(v, jax.Array) and not v.is_fully_addressable:
+            if v.sharding.is_fully_replicated:
+                return np.asarray(v.addressable_data(0))
+            raise ValueError(
+                f"rank-0 checkpointing needs replicated or process-local "
+                f"arrays; got a cross-process sharded array "
+                f"{v.shape} ({v.sharding}) — all-gather it before save() "
+                f"or checkpoint per-shard with your own orbax setup")
+        return v
+
+    state = jax.tree.map(_to_host, state)
     if background:
-        # Orbax copies device arrays before returning but writes host numpy
-        # leaves from the caller's live buffers — snapshot those so later
-        # in-place mutation cannot tear the checkpoint.
-        state = jax.tree.map(
-            lambda v: v.copy() if isinstance(v, np.ndarray) else v, state)
+        # Orbax copies device arrays before returning but writes host
+        # leaves from the caller's live buffers — snapshot every mutable
+        # host leaf (numpy, torch tensors, lists, ...) so later in-place
+        # mutation cannot tear the checkpoint.  jax.Array and immutable
+        # scalars/strings pass through untouched.
+        def _snapshot(v):
+            if isinstance(v, np.ndarray):
+                return v.copy()
+            if isinstance(v, (jax.Array, int, float, complex, bool, str,
+                              bytes, type(None))):
+                return v
+            # torch tensors, array-likes, lists: materialize an
+            # independent numpy copy (orbax serializes it identically).
+            try:
+                return np.array(v, copy=True)
+            except Exception:
+                return v  # non-array leaf orbax knows how to handle
+        state = jax.tree.map(_snapshot, state)
         _get_async_checkpointer().save(path, state, force=force)
         return
     # A sync save must not race an in-flight background commit to the same
@@ -116,6 +146,85 @@ def save(path: str | os.PathLike, state: Any, *, force: bool = True,
     wait_pending()
     with _lone_checkpointer() as ckptr:
         ckptr.save(path, state, force=force)
+
+
+def _key_str(k):
+    """jax.tree_util path entry → plain key (GetAttrKey/DictKey/SequenceKey)."""
+    for attr in ("name", "key", "idx"):
+        if hasattr(k, attr):
+            return getattr(k, attr)
+    return str(k)
+
+
+def _adapt_compression_state(raw, template):
+    """Map a template-less orbax restore onto ``template``, migrating
+    optimizer state across compression modes (training.DistributedState ↔
+    DistributedEFState — the structure changes when
+    ``DistributedOptimizer(compression=...)`` is toggled between save and
+    resume, reference keras/__init__.py:115-148 restore-must-rewrap
+    contract):
+
+    * plain → int8-EF: the missing error-feedback residuals initialize to
+      zeros of the template's shapes (exactly a fresh EF start);
+    * int8-EF → plain: the saved residuals are dropped with a warning
+      (their precision re-entry is lost, nothing else).
+
+    Any other structural mismatch raises, so genuinely incompatible
+    checkpoints still fail loudly."""
+    import warnings
+
+    import jax.numpy as jnp
+    from jax import tree_util as jtu
+
+    from horovod_tpu import training as _training
+
+    # Anchor the heuristic to ACTUAL Distributed*State nodes in the
+    # template — a model that legitimately has a key named "error"
+    # elsewhere must not be silently zero-filled or dropped.
+    def _node_paths(is_node):
+        paths, _ = jtu.tree_flatten_with_path(template, is_leaf=is_node)
+        return {tuple(_key_str(k) for k in p)
+                for p, v in paths if is_node(v)}
+
+    ef_prefixes = {p + ("error",) for p in _node_paths(
+        lambda v: isinstance(v, _training.DistributedEFState))}
+    ds_prefixes = {p + ("error",) for p in _node_paths(
+        lambda v: isinstance(v, _training.DistributedState))}
+
+    def _under(key, prefixes):
+        return any(key[:len(p)] == p for p in prefixes)
+
+    t_paths, treedef = jtu.tree_flatten_with_path(template)
+    raw_leaves = {tuple(_key_str(k) for k in path): v
+                  for path, v in jtu.tree_flatten_with_path(raw)[0]}
+    out, used, filled = [], set(), []
+    for path, t_leaf in t_paths:
+        key = tuple(_key_str(k) for k in path)
+        if key in raw_leaves:
+            out.append(raw_leaves[key])
+            used.add(key)
+        elif _under(key, ef_prefixes) and hasattr(t_leaf, "shape"):
+            out.append(jnp.zeros(t_leaf.shape, t_leaf.dtype))
+            filled.append(key)
+        else:
+            raise KeyError(
+                f"checkpoint has no value for {key} and it is not an "
+                f"error-feedback residual — incompatible checkpoint")
+    dropped = [k for k in raw_leaves if k not in used]
+    if any(not _under(k, ds_prefixes) for k in dropped):
+        raise KeyError(
+            f"checkpoint contains entries the template does not: "
+            f"{[k for k in dropped if not _under(k, ds_prefixes)][:5]}")
+    if filled:
+        warnings.warn(
+            f"restored a checkpoint saved without int8 error feedback into "
+            f"an EF optimizer: {len(filled)} residual(s) initialized to "
+            f"zero (fresh EF start)")
+    if dropped:
+        warnings.warn(
+            f"restored a checkpoint saved with int8 error feedback into a "
+            f"plain optimizer: {len(dropped)} residual(s) dropped")
+    return jtu.tree_unflatten(treedef, out)
 
 
 def restore(path: str | os.PathLike, template: Any | None = None,
@@ -127,6 +236,10 @@ def restore(path: str | os.PathLike, template: Any | None = None,
     stale-filesystem assumption): with a ``template``, other ranks receive
     the arrays via collective broadcast; without one, the whole tree moves
     as one object broadcast.
+
+    A checkpoint saved under a different compression mode than the
+    ``template`` (plain ↔ int8 error-feedback optimizer state) migrates
+    automatically — see ``_adapt_compression_state``.
     """
     def read():
         import orbax.checkpoint as ocp
@@ -135,7 +248,16 @@ def restore(path: str | os.PathLike, template: Any | None = None,
         p = os.path.abspath(os.fspath(path))
         with _lone_checkpointer() as ckptr:
             if template is not None:
-                return ckptr.restore(p, ocp.args.PyTreeRestore(template))
+                try:
+                    return ckptr.restore(p, ocp.args.PyTreeRestore(template))
+                except Exception as exc:
+                    # Structure mismatch: attempt the compression-mode
+                    # migration from a raw (template-less) read.
+                    raw = ckptr.restore(p)
+                    try:
+                        return _adapt_compression_state(raw, template)
+                    except KeyError:
+                        raise exc from None
             return ckptr.restore(p)
 
     if basics.size() == 1 or not broadcast:

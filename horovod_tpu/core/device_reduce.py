@@ -50,11 +50,28 @@ _mesh = None
 _dense_cache: dict = {}
 _int8_cache: dict = {}
 _seg_cache: dict = {}
+_gather_cache: dict = {}
+_bcast_cache: dict = {}
 
 
 def enabled() -> bool:
     """Device reduction is the default; HVD_TPU_EAGER_REDUCE=gather disables."""
     return os.environ.get("HVD_TPU_EAGER_REDUCE", "device") != "gather"
+
+
+def require_full_job(op: str) -> None:
+    """The legacy multihost_utils transport spans EVERY jax process; under
+    a rank-subset job (init(ranks=...)) it would enroll non-members — the
+    one shared guard every legacy-transport fallback calls before touching
+    multihost_utils."""
+    from horovod_tpu import basics
+
+    if basics.is_initialized() and basics.subset_active():
+        raise NotImplementedError(
+            f"{op}: the legacy gather transport (HVD_TPU_EAGER_REDUCE="
+            f"gather) spans all jax processes and cannot serve a "
+            f"rank-subset job (init(ranks=...)); use the device data "
+            f"plane (default)")
 
 
 def reset() -> None:
@@ -65,14 +82,30 @@ def reset() -> None:
         _dense_cache.clear()
         _int8_cache.clear()
         _seg_cache.clear()
+        _gather_cache.clear()
+        _bcast_cache.clear()
+
+
+def _members() -> tuple:
+    """jax process ids in the job, in rank order (subset-aware)."""
+    import jax
+
+    from horovod_tpu import basics
+
+    if basics.is_initialized():
+        return tuple(basics.member_process_ids())
+    return tuple(range(jax.process_count()))
 
 
 def _process_mesh():
-    """(P,) mesh over the first local device of every process.
+    """(P,) mesh over the first local device of every JOB process.
 
     One device per process carries the eager wire: eager collectives have
     process-level semantics (one contribution per process, like one
     reference rank per host), so the remaining local devices take no part.
+    Rank-subset jobs (``init(ranks=...)``) mesh only the member processes —
+    the device data plane serves subsets natively, unlike the legacy
+    ``multihost_utils`` transport which always spans the full jax job.
     """
     global _mesh
     import jax
@@ -83,9 +116,17 @@ def _process_mesh():
             first = {}
             for d in jax.devices():
                 first.setdefault(d.process_index, d)
-            devs = np.array([first[p] for p in range(jax.process_count())])
+            devs = np.array([first[p] for p in _members()])
             _mesh = Mesh(devs, (AXIS,))
         return _mesh
+
+
+def _my_position(mesh) -> int:
+    import jax
+
+    members = _members()
+    assert mesh.size == len(members)
+    return members.index(jax.process_index())
 
 
 def _my_row_array(mesh, row: np.ndarray, n_cols: int):
@@ -93,7 +134,7 @@ def _my_row_array(mesh, row: np.ndarray, n_cols: int):
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    dev = mesh.devices.flat[jax.process_index()]
+    dev = mesh.devices.flat[_my_position(mesh)]
     local = jax.device_put(row.reshape(1, n_cols), dev)
     return jax.make_array_from_single_device_arrays(
         (mesh.size, n_cols), NamedSharding(mesh, P(AXIS, None)), [local])
@@ -103,7 +144,7 @@ def _replicated(mesh, arr: np.ndarray):
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    dev = mesh.devices.flat[jax.process_index()]
+    dev = mesh.devices.flat[_my_position(mesh)]
     local = jax.device_put(arr, dev)
     return jax.make_array_from_single_device_arrays(
         arr.shape, NamedSharding(mesh, P()), [local])
@@ -168,6 +209,63 @@ def process_allreduce(flat: np.ndarray) -> np.ndarray:
     out = _dense_reducer(mesh, n_pad, flat.dtype)(
         _my_row_array(mesh, row, n_pad))
     return np.asarray(out.addressable_data(0))[:n]
+
+
+def process_allgather(arr: np.ndarray) -> np.ndarray:
+    """Gather each process's ``arr`` (identical shape/dtype everywhere) into
+    a ``(P,) + arr.shape`` array over the job's device mesh — the device
+    analog of ``multihost_utils.process_allgather``, subset-aware.
+    8-byte dtypes (not device-representable without x64) ride internally
+    as a uint8 view and are re-viewed on arrival."""
+    if arr.dtype.itemsize == 8:
+        wire = np.ascontiguousarray(arr).view(np.uint8)
+        out = process_allgather(wire.reshape(-1))
+        return np.ascontiguousarray(out).view(arr.dtype).reshape(
+            (out.shape[0],) + arr.shape)
+    import jax
+    import jax.numpy as jnp  # noqa: F401  (kernel below traces lazily)
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    mesh = _process_mesh()
+    n = arr.size
+    key = (mesh.size, n, arr.dtype.name)
+    fn = _gather_cache.get(key)
+    if fn is None:
+        def f(row):  # (1, n) local → (P, n) replicated
+            return lax.all_gather(row[0], AXIS, tiled=False)
+
+        fn = jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=P(AXIS, None), out_specs=P(),
+            check_vma=False))
+        _gather_cache[key] = fn
+    out = fn(_my_row_array(mesh, np.ascontiguousarray(arr).reshape(1, n), n))
+    return np.asarray(out.addressable_data(0)).reshape(
+        (mesh.size,) + arr.shape)
+
+
+def process_broadcast(arr: np.ndarray, root: int) -> np.ndarray:
+    """Every process receives job-rank ``root``'s value, via a masked
+    reduce-scatter -> allgather over the job mesh (~2n wire bytes; the mask
+    zeroes every contribution but the root's, so the sum IS the broadcast
+    — exact for every dtype since all other contributions are zero).
+    8-byte dtypes ride internally as a uint8 view (byte sums cannot wrap:
+    only the root contributes non-zero bytes)."""
+    if arr.dtype.itemsize == 8:
+        wire = np.ascontiguousarray(arr).view(np.uint8)
+        return np.ascontiguousarray(
+            process_broadcast(wire.reshape(-1), root)).view(
+                arr.dtype).reshape(arr.shape)
+    from horovod_tpu import basics
+
+    me = basics.rank() if basics.is_initialized() else None
+    if me is None:
+        import jax
+
+        me = _members().index(jax.process_index())
+    src = arr if me == root else np.zeros_like(arr)
+    return process_allreduce(np.ascontiguousarray(src).ravel()).reshape(
+        arr.shape)
 
 
 def _int8_reducer(mesh, n_pad: int, nt: int):

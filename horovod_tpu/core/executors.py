@@ -87,6 +87,12 @@ def _from_wire(a: np.ndarray, dtype: np.dtype) -> np.ndarray:
     return a
 
 
+def _require_full_job(op: str) -> None:
+    from horovod_tpu.core import device_reduce
+
+    device_reduce.require_full_job(op)
+
+
 def multihost_executor(engine, batch) -> None:
     import jax.numpy as jnp
     from jax.experimental import multihost_utils
@@ -131,9 +137,18 @@ def multihost_executor(engine, batch) -> None:
             summed = device_reduce.process_allreduce(flat)
         else:
             wire, dtype = _as_wire(flat)
-            gathered = multihost_utils.process_allgather(
-                jnp.asarray(wire)[None], tiled=False)
-            rows = _from_wire(np.asarray(gathered).reshape(size, -1), dtype)
+            if device_reduce.enabled() and flat.dtype.itemsize == 8:
+                # 8-byte allreduce: gather the byte view over the device
+                # plane (subset-safe), then host-sum at full precision.
+                rows = _from_wire(
+                    device_reduce.process_allgather(wire).reshape(size, -1),
+                    dtype)
+            else:
+                _require_full_job("allreduce")
+                gathered = multihost_utils.process_allgather(
+                    jnp.asarray(wire)[None], tiled=False)
+                rows = _from_wire(np.asarray(gathered).reshape(size, -1),
+                                  dtype)
             if rows.dtype.name in ("float16", "bfloat16"):
                 # Half-precision wire, float32 accumulation (half.cc staging).
                 summed = _staged_f32_sum(rows)
@@ -158,6 +173,8 @@ def multihost_executor(engine, batch) -> None:
         engine.batch_activity(
             batch, "PROCESS_ALLGATHER" if batch.type ==
             engine_mod.OP_ALLGATHER else "PROCESS_ALLTOALL")
+        from horovod_tpu.core import device_reduce
+
         a = inputs[0]
         sizes = batch.first_dim_sizes
         max_d = max(sizes) if sizes else a.shape[0]
@@ -175,11 +192,18 @@ def multihost_executor(engine, batch) -> None:
             # below; a bare view would scale dim 0 of 1-D arrays by 8).
             wire = np.ascontiguousarray(
                 padded.reshape(max_d, -1)).view(np.uint8)
-            gathered = np.asarray(multihost_utils.process_allgather(
-                jnp.asarray(wire)[None], tiled=False))
+            if device_reduce.enabled():
+                gathered = device_reduce.process_allgather(wire)
+            else:
+                _require_full_job("allgather")
+                gathered = np.asarray(multihost_utils.process_allgather(
+                    jnp.asarray(wire)[None], tiled=False))
             gathered = np.ascontiguousarray(
                 gathered.reshape(size, max_d, -1)).view(a.dtype)
+        elif device_reduce.enabled():
+            gathered = device_reduce.process_allgather(padded)
         else:
+            _require_full_job("allgather")
             gathered = np.asarray(multihost_utils.process_allgather(
                 jnp.asarray(padded)[None], tiled=False))
         gathered = gathered.reshape((size, max_d) + a.shape[1:])
@@ -187,11 +211,18 @@ def multihost_executor(engine, batch) -> None:
         engine.put_results(batch, [np.concatenate(pieces, axis=0)])
     elif batch.type == engine_mod.OP_BROADCAST:
         engine.batch_activity(batch, "PROCESS_BROADCAST")
+        from horovod_tpu.core import device_reduce
+
         a = inputs[0]
         wire, dtype = _as_wire(a)
-        out = _from_wire(np.asarray(multihost_utils.broadcast_one_to_all(
-            jnp.asarray(wire), is_source=engine.rank == batch.root_rank)),
-            dtype).reshape(a.shape)
+        if device_reduce.enabled():
+            out = _from_wire(device_reduce.process_broadcast(
+                wire, batch.root_rank), dtype).reshape(a.shape)
+        else:
+            _require_full_job("broadcast")
+            out = _from_wire(np.asarray(multihost_utils.broadcast_one_to_all(
+                jnp.asarray(wire), is_source=engine.rank == batch.root_rank)),
+                dtype).reshape(a.shape)
         engine.put_results(batch, [out])
     else:
         raise NotImplementedError(f"batch type {batch.type}")

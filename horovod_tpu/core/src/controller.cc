@@ -166,11 +166,13 @@ std::unique_ptr<TcpControlPlane> TcpControlPlane::MakeCoordinator(
     timeval zero{};
     ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &zero, sizeof(zero));
     if (!hello_ok) {
+      ::close(fd);  // not yet registered: the destructor can't release it
       *err = "bad hello";
       return nullptr;
     }
     std::memcpy(&rank, hello.data(), 4);
     if (rank < 1 || rank >= size || cp->worker_fds_[rank - 1] != -1) {
+      ::close(fd);
       *err = "bad hello rank " + std::to_string(rank);
       return nullptr;
     }

@@ -40,13 +40,16 @@ _data_axes: tuple[str, ...] = (DATA_AXIS,)
 
 
 def build_global_mesh(extra_axes: dict[str, int] | None = None, *,
-                      cross_size: int | None = None) -> Mesh:
+                      cross_size: int | None = None,
+                      devices=None) -> Mesh:
     """Create (or return) the process-wide mesh.
 
     ``extra_axes`` maps model-parallel axis names to sizes; the data-parallel
     width becomes ``num_chips / prod(extra_axes)``.  Device order follows
     JAX's topology-aware ordering so neighbouring mesh coordinates are
     ICI neighbours (the property the reference got from NCCL ring setup).
+    ``devices`` restricts the mesh (rank-subset jobs, ``init(ranks=...)``);
+    default is every device in the jax job.
 
     Once built, the mesh is fixed for the life of the process (like the
     reference's communicators): asking for different ``extra_axes`` later is
@@ -66,7 +69,9 @@ def build_global_mesh(extra_axes: dict[str, int] | None = None, *,
             return _mesh
         from horovod_tpu import basics
 
-        devices = jax.devices()
+        if devices is None:
+            devices = jax.devices()
+        devices = list(devices)
         n = len(devices)
         if cross_size is not None:
             cross = cross_size

@@ -215,12 +215,30 @@ def quantized_grouped_allreduce(tensors: Sequence, errors: Sequence | None = Non
         return _eager_quantized_reduce(list(tensors), errors,
                                        average=average)
     width = _data_width(axes)
-    if width > 127:
+    if len(axes) >= 2:
+        # Hierarchical (dcn, ici) mesh: each TIER sum-fits independently
+        # (reference operations.cc:1025-1177 hierarchy, re-derived for the
+        # int8 wire).  The quantization grid only has to fit the ICI-tier
+        # sum — ±(127//ici_size) levels instead of ±(127//total_width) — so
+        # any width whose tiers are each <= 127 is admissible: width 512 as
+        # (dcn=64, ici=8) quantizes at ±15 levels where a flat 127-cap
+        # would refuse outright (and width 64 as (8, 8) gets ±15 instead
+        # of the flat path's ±1).
+        dcn_n, ici_n = (lax.axis_size(axes[0]), lax.axis_size(axes[1]))
+        if max(dcn_n, ici_n) > 127:
+            raise ValueError(
+                f"hierarchical int8 allreduce sum-fits at most 127 workers "
+                f"per tier (mesh here: dcn={dcn_n}, ici={ici_n}); reshape "
+                f"the mesh or use Compression.bf16.")
+        qcap = max(127 // ici_n, 1)
+    elif width > 127:
         raise ValueError(
             f"int8 quantized allreduce sum-fits at most 127 workers on the "
-            f"wire (data width here: {width}); use Compression.bf16 beyond "
-            f"that, or shrink the data axis (e.g. ZeRO/hierarchical DP).")
-    qcap = max(127 // width, 1)
+            f"wire (data width here: {width}); build a hierarchical "
+            f"(dcn, ici) mesh (each tier <= 127 — see parallel/hierarchy.py) "
+            f"or use Compression.bf16.")
+    else:
+        qcap = max(127 // width, 1)
     for t in tensors:
         if not jnp.issubdtype(t.dtype, jnp.floating):
             raise ValueError(
@@ -263,11 +281,41 @@ def quantized_grouped_allreduce(tensors: Sequence, errors: Sequence | None = Non
         resid.append(jnp.where(finite, t - q.astype(t.dtype) * scale,
                                jnp.zeros_like(t)))
 
-    # |any partial or total sum| <= width*qcap <= 127: no int8 overflow,
-    # including the hierarchical ICI-scatter -> DCN -> ICI-gather route
-    # (the int8 shard is what crosses DCN — the bandwidth win compounds).
-    summed = fusion.fused_apply(qs, lambda flat: _mesh_allreduce(flat, axes),
-                                threshold_bytes)
+    if len(axes) >= 2:
+        # Tiered sum-fit: int8 reduce-scatter on ICI (|partial| <=
+        # ici*qcap <= 127), REQUANTIZE the shard onto the DCN tier's own
+        # sum-fitting grid, int8 psum across DCN, all_gather back.  The
+        # requantization factor qcap2/s1_max is applied to unitless GRID
+        # COUNTS, so one factor serves every tensor in a fused bucket and
+        # per-tensor scales still dequantize outside.  Extra error from
+        # the stage-2 rounding: <= dcn * s1_max/(2*qcap2) counts per
+        # element (in value terms, that times the tensor's scale) — the
+        # price of sum-fitting only per tier; error feedback carries the
+        # stage-1 residuals as usual.
+        dcn_ax, ici_ax = axes
+        qcap2 = max(127 // dcn_n, 1)
+        s1_max = ici_n * qcap
+
+        def _tiered(flat):
+            n = flat.shape[0]
+            pad = (-n) % ici_n
+            if pad:
+                flat = jnp.pad(flat, (0, pad))
+            shard = lax.psum_scatter(flat, ici_ax, tiled=True)
+            red = shard.astype(jnp.float32)
+            if dcn_n > 1:
+                q2 = jnp.round(red * (qcap2 / s1_max)).astype(jnp.int8)
+                red = lax.psum(q2, dcn_ax).astype(jnp.float32) \
+                    * (s1_max / qcap2)
+            out = lax.all_gather(red, ici_ax, tiled=True)
+            return out[:n] if pad else out
+
+        summed = fusion.fused_apply(qs, _tiered, threshold_bytes)
+    else:
+        # |any partial or total sum| <= width*qcap <= 127: no int8 overflow
+        # on the flat psum.
+        summed = fusion.fused_apply(
+            qs, lambda flat: _mesh_allreduce(flat, axes), threshold_bytes)
     inv = (1.0 / width) if average else 1.0
     # Dequantize in f32: for fp16 gradients the intermediate sum (up to
     # width*amax) can overflow to inf in the gradient dtype even when the
@@ -340,6 +388,7 @@ def _eager_quantized_reduce(tensors, errors, average: bool):
         if size == 1:
             rows = payload[None]
         else:
+            _require_full_job("quantized allreduce")
             rows = np.asarray(multihost_utils.process_allgather(
                 jnp.asarray(payload)[None], tiled=False)).reshape(size, -1)
         acc = qwire.unpack_sum_int8(rows, sizes)
@@ -362,23 +411,48 @@ def _eager_quantized_reduce(tensors, errors, average: bool):
     return reduced, resid
 
 
+def _require_full_job(op: str) -> None:
+    from horovod_tpu.core import device_reduce
+
+    device_reduce.require_full_job(op)
+
+
+def _process_gather(arr: np.ndarray) -> np.ndarray:
+    """(P,) + arr.shape gather over job processes (device plane when
+    enabled — subset-safe; legacy multihost_utils otherwise)."""
+    from horovod_tpu.core import device_reduce
+
+    if device_reduce.enabled():
+        return device_reduce.process_allgather(arr)
+    _require_full_job("allgather")
+    return np.asarray(multihost_utils.process_allgather(
+        jnp.asarray(arr)[None], tiled=False)).reshape(
+            (basics.size(),) + arr.shape)
+
+
 def _eager_process_reduce(x):
     if basics.size() == 1:
         return jnp.asarray(x)
     from horovod_tpu.core import device_reduce
 
-    arr = np.asarray(x)
-    # Floating dtypes only: the legacy path's jnp.sum PROMOTES small ints
-    # and bool to int32 results, a public-API behavior the device reducer
-    # (which keeps the input dtype) must not silently change; integer eager
-    # reductions are metric-sized, so the gather path costs nothing.
-    floating = arr.dtype.kind == "f" or arr.dtype.name == "bfloat16"
-    if device_reduce.enabled() and floating and arr.dtype.itemsize != 8:
-        # Reduce-scatter -> allgather on device (~2n wire bytes per rank,
-        # core/device_reduce.py) — the reference's MPI_Allreduce ring
-        # economics instead of allgather+host-sum.
-        return jnp.asarray(
-            device_reduce.process_allreduce(arr.ravel()).reshape(arr.shape))
+    # jnp.asarray first: jax-wide dtype rules apply either way (64-bit
+    # downcasts without x64), keeping device and legacy results identical.
+    arr = np.asarray(jnp.asarray(x))
+    if device_reduce.enabled():
+        floating = arr.dtype.kind == "f" or arr.dtype.name == "bfloat16"
+        if floating and arr.dtype.itemsize != 8:
+            # Reduce-scatter -> allgather on device (~2n wire bytes per
+            # rank, core/device_reduce.py) — the reference's MPI_Allreduce
+            # ring economics instead of allgather+host-sum.
+            return jnp.asarray(device_reduce.process_allreduce(
+                arr.ravel()).reshape(arr.shape))
+        # ints/bool (the public API PROMOTES via jnp.sum — int8 sums to
+        # int32, bool to counts) and x64 floats (f64 rides the gather's
+        # internal byte view): gather on the device plane, sum on host in
+        # the promoted/full-precision dtype.  Metric-sized payloads.
+        return jnp.sum(jnp.asarray(device_reduce.process_allgather(arr)),
+                       axis=0)
+    _require_full_job("allreduce")
     gathered = multihost_utils.process_allgather(jnp.asarray(x)[None], tiled=False)
     return jnp.sum(gathered.reshape((basics.size(),) + jnp.shape(x)), axis=0)
 
@@ -405,12 +479,11 @@ def allgather(tensor, name: str | None = None):
     if basics.size() == 1:
         return tensor
     dim0 = jnp.shape(tensor)[0] if tensor.ndim else 1
-    sizes = multihost_utils.process_allgather(jnp.array([dim0]), tiled=False)
-    sizes = sizes.reshape(-1)
+    sizes = _process_gather(np.asarray([dim0], np.int32)).reshape(-1)
     max_d = int(sizes.max())
     pad = [(0, max_d - dim0)] + [(0, 0)] * (tensor.ndim - 1)
     padded = jnp.pad(tensor, pad)
-    gathered = multihost_utils.process_allgather(padded[None], tiled=False)
+    gathered = jnp.asarray(_process_gather(np.asarray(padded)))
     gathered = gathered.reshape((basics.size(), max_d) + tensor.shape[1:])
     pieces = [gathered[r, : int(sizes[r])] for r in range(basics.size())]
     return jnp.concatenate(pieces, axis=0)
@@ -472,6 +545,12 @@ def broadcast(tensor, root_rank: int = 0, name: str | None = None):
     _require_not_traced("broadcast")
     if basics.size() == 1:
         return jnp.asarray(tensor)
+    from horovod_tpu.core import device_reduce
+
+    if device_reduce.enabled():
+        arr = np.asarray(jnp.asarray(tensor))
+        return jnp.asarray(device_reduce.process_broadcast(arr, root_rank))
+    _require_full_job("broadcast")
     return multihost_utils.broadcast_one_to_all(
         jnp.asarray(tensor), is_source=basics.rank() == root_rank)
 

@@ -40,62 +40,107 @@ except ImportError:  # pragma: no cover
 NEG_INF = -1e30
 
 
-def _flash_kernel(meta_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
-                  block_q: int, block_k: int, num_k_blocks: int,
+def _flash_kernel(meta_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref,
+                  l_ref, *, block_q: int, block_k: int, num_k_blocks: int,
                   causal: bool, scale: float):
-    """One (batch·head, q-block) program: stream K/V blocks, online softmax.
+    """One (batch·head, q-block, k-block) program: online softmax with the
+    K-block axis as a GRID dimension — Pallas streams each (block_k, D)
+    K/V tile HBM→VMEM double-buffered, and the (m, l, acc) carry lives in
+    VMEM-resident output blocks (index maps constant in ki), so scoped
+    VMEM is one tile of each operand plus the [bq, bk] intermediates,
+    independent of S.
 
     meta_ref (SMEM int32[3]): [q_offset, k_offset, k_len] — global position
     offsets (sequence parallelism) and the unpadded K length.
-    """
-    qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale              # [bq, D]
-    d = q.shape[-1]
-    q_pos = (meta_ref[0] + qi * block_q
-             + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0))
 
-    def body(ki, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(ki * block_k, block_k), :]     # [bk, D]
-        v = v_ref[0, pl.ds(ki * block_k, block_k), :]
+    INTERIOR K blocks (entirely below the causal diagonal and entirely
+    inside the valid K range) take a mask-free body: no iota/compare/
+    select per element — only the diagonal and boundary blocks pay for
+    masking.  At long S that is ~all blocks exempted, which matters
+    because the mask arithmetic runs on the VPU while the matmuls it
+    brackets run on the MXU.
+
+    ``m_ref``/``l_ref`` are carry storage in the lse layout (sublane-
+    replicated (8, block_q)); callers discard them.  ``o_ref`` is f32
+    (accumulation precision); the caller casts.
+    """
+    qi, ki = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[0] = jnp.full_like(m_ref[0], NEG_INF)
+        l_ref[0] = jnp.zeros_like(l_ref[0])
+        o_ref[0] = jnp.zeros_like(o_ref[0])
+
+    q_min = meta_ref[0] + qi * block_q
+    q_max = q_min + block_q - 1
+    k_min = meta_ref[1] + ki * block_k
+    k_max = k_min + block_k - 1
+    run = (k_min <= q_max) if causal else True
+    interior = k_max < meta_ref[2]
+    if causal:
+        interior = jnp.logical_and(interior, k_max <= q_min)
+
+    def _compute(masked: bool):
+        q = q_ref[0].astype(jnp.float32) * scale          # [bq, D]
+        k = k_ref[0]                                      # [bk, D]
+        v = v_ref[0]
+        m = m_ref[0, 0, :][:, None]                       # [bq, 1]
+        l = l_ref[0, 0, :][:, None]
         s = jax.lax.dot_general(
             q, k.astype(jnp.float32), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)           # [bq, bk]
-        k_pos = (meta_ref[1] + ki * block_k
-                 + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1))
-        mask = k_pos < meta_ref[2]                        # padding mask
-        if causal:
-            mask = jnp.logical_and(mask, q_pos >= k_pos)
-        s = jnp.where(mask, s, NEG_INF)
+        if masked:
+            q_pos = (q_min + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0))
+            k_pos = (k_min + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1))
+            mask = k_pos < meta_ref[2]                    # padding mask
+            if causal:
+                mask = jnp.logical_and(mask, q_pos >= k_pos)
+            s = jnp.where(mask, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
-        p = jnp.where(mask, p, 0.0)
+        if masked:
+            p = jnp.where(mask, p, 0.0)
         corr = jnp.exp(m - m_new)
         l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
         pv = jax.lax.dot_general(
             p, v.astype(jnp.float32), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return m_new, l_new, acc * corr + pv
+        o_ref[0] = o_ref[0] * corr + pv
+        m_ref[0] = jnp.broadcast_to(m_new[:, 0][None, :], m_ref.shape[1:])
+        l_ref[0] = jnp.broadcast_to(l_new[:, 0][None, :], l_ref.shape[1:])
 
-    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
-    if causal:
-        # Skip K blocks entirely above the diagonal: the last contributing
-        # block is the one containing this q-block's max position.  Halves
-        # the streamed blocks for causal attention (dynamic fori bound).
-        q_max = meta_ref[0] + (qi + 1) * block_q - 1
-        hi = jnp.clip((q_max - meta_ref[1]) // block_k + 1, 0, num_k_blocks)
-    else:
-        hi = num_k_blocks
-    m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
-    # log-sum-exp per query row (NEG_INF where a row attended to nothing) —
-    # lets callers combine partial attentions exactly (ring attention).
-    # Stored sublane-replicated (8, block_q): Mosaic requires the last two
-    # block dims be (8k, 128k)-tileable, which a (1, block_q) row is not.
-    lse = jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-30)), NEG_INF)
-    lse_ref[0] = jnp.broadcast_to(lse[:, 0][None, :], lse_ref.shape[1:])
+    @pl.when(jnp.logical_and(run, interior))
+    def _compute_interior():
+        _compute(masked=False)
+
+    @pl.when(jnp.logical_and(run, jnp.logical_not(interior)))
+    def _compute_boundary():
+        _compute(masked=True)
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finish():
+        m = m_ref[0, 0, :][:, None]
+        l = l_ref[0, 0, :][:, None]
+        o_ref[0] = o_ref[0] / jnp.maximum(l, 1e-30)
+        # log-sum-exp per query row (NEG_INF where a row attended to
+        # nothing) — lets callers combine partial attentions exactly
+        # (ring attention).  Stored sublane-replicated (8, block_q):
+        # Mosaic requires the last two block dims be (8k, 128k)-tileable,
+        # which a (1, block_q) row is not.
+        lse = jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-30)), NEG_INF)
+        lse_ref[0] = jnp.broadcast_to(lse[:, 0][None, :], lse_ref.shape[1:])
+
+
+def _dims_arbitrary_last():
+    """Mosaic dimension semantics for the backward grids: outer axes are
+    parallel, the innermost is the sequential accumulation sweep."""
+    if pltpu is None:  # pragma: no cover - CPU-only builds
+        return None
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"))
 
 
 def _pad_to(x, axis, multiple):
@@ -131,27 +176,34 @@ def _flash_forward(q, k, v, causal, q_offset, k_offset, block_q, block_k,
         _flash_kernel, block_q=block_q, block_k=block_k,
         num_k_blocks=num_k_blocks, causal=causal, scale=scale)
     smem = {"memory_space": _SMEM} if _SMEM is not None else {}
-    out, lse = pl.pallas_call(
+    carry_shape = jax.ShapeDtypeStruct((qb.shape[0], 8, qb.shape[1]),
+                                       jnp.float32)
+    out, lse, _m, _l = pl.pallas_call(
         kernel,
-        grid=(b * h, num_q_blocks),
+        grid=(b * h, num_q_blocks, num_k_blocks),
         in_specs=[
-            pl.BlockSpec((3,), lambda bh, qi: (0,), **smem),
-            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, kb.shape[1], d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, kb.shape[1], d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((3,), lambda bh, qi, ki: (0,), **smem),
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
         ],
         out_specs=(
-            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, 8, block_q), lambda bh, qi: (bh, 0, qi)),
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, 8, block_q), lambda bh, qi, ki: (bh, 0, qi)),
+            pl.BlockSpec((1, 8, block_q), lambda bh, qi, ki: (bh, 0, qi)),
+            pl.BlockSpec((1, 8, block_q), lambda bh, qi, ki: (bh, 0, qi)),
         ),
         out_shape=(
-            jax.ShapeDtypeStruct(qb.shape, q.dtype),
-            jax.ShapeDtypeStruct((qb.shape[0], 8, qb.shape[1]),
-                                 jnp.float32),
+            jax.ShapeDtypeStruct(qb.shape, jnp.float32),  # f32 accumulator
+            carry_shape,   # lse
+            carry_shape,   # m carry (discarded)
+            carry_shape,   # l carry (discarded)
         ),
+        compiler_params=_dims_arbitrary_last(),
         interpret=interpret,
     )(meta, qb, kb, vb)
-    out = out[:, :s_q].reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
+    out = out.astype(q.dtype)[:, :s_q].reshape(b, h, s_q, d)
+    out = out.transpose(0, 2, 1, 3)
     if with_lse:
         # [B·H, 8, S] (sublane-replicated) → [B, S, H]
         lse = lse[:, 0, :s_q].reshape(b, h, s_q).transpose(0, 2, 1)
@@ -162,99 +214,152 @@ def _flash_forward(q, k, v, causal, q_offset, k_offset, block_q, block_k,
 def _bwd_dq_kernel(meta_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, *, block_q: int, block_k: int, num_k_blocks: int,
                    causal: bool, scale: float):
-    """One (batch·head, q-block) program: dq = Σ_k  p·(dp − Δ) · K · scale."""
-    qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale              # [bq, D]
-    do = do_ref[0].astype(jnp.float32)                    # [bq, D]
-    lse = lse_ref[0, 0, :][:, None]                       # [bq, 1]
-    delta = delta_ref[0, 0, :][:, None]
-    row_ok = lse > NEG_INF / 2                            # rows that attended
-    q_pos = (meta_ref[0] + qi * block_q
-             + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0))
+    """One (batch·head, q-block, k-block) program: dq += p·(dp − Δ)·K.
 
-    def body(ki, dq):
-        k = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+    The k-block axis is a GRID dimension, not an in-kernel loop: Pallas
+    streams each (block_k, D) K/V tile HBM→VMEM double-buffered, and the
+    f32 dq output block (index map constant in ki) stays VMEM-resident as
+    the accumulator across the ki sweep.  Scoped VMEM is one tile of each
+    operand plus the [bq, bk] intermediates — independent of S, which is
+    what lets block_k ≥ 1024 compile where the round-2 whole-sequence
+    layout overflowed the 16 MiB VMEM bound at S=8192.
+    """
+    qi, ki = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_ref[0] = jnp.zeros_like(dq_ref[0])
+
+    # Block classification (positions are SMEM scalars, so this is scalar
+    # arithmetic): blocks entirely above the diagonal contribute p == 0 —
+    # skip their compute (their tiles still stream; attention here is
+    # MXU-bound, so masked-out compute, not fetch, is the cost that
+    # counts).  INTERIOR blocks — entirely below the diagonal and inside
+    # the valid K range — take a mask-free body: no per-element iota/
+    # compare/select (VPU work bracketing the MXU matmuls); only diagonal
+    # and boundary blocks pay for masking.  Padded q rows are safe
+    # maskless: their lse is +1e30, so p = exp(s - lse) == 0.
+    q_min = meta_ref[0] + qi * block_q
+    q_max = q_min + block_q - 1
+    k_min = meta_ref[1] + ki * block_k
+    k_max = k_min + block_k - 1
+    run = (k_min <= q_max) if causal else True
+    interior = k_max < meta_ref[2]
+    if causal:
+        interior = jnp.logical_and(interior, k_max <= q_min)
+
+    def _compute(masked: bool):
+        q = q_ref[0].astype(jnp.float32) * scale          # [bq, D]
+        do = do_ref[0].astype(jnp.float32)                # [bq, D]
+        lse = lse_ref[0, 0, :][:, None]                   # [bq, 1]
+        delta = delta_ref[0, 0, :][:, None]
+        k = k_ref[0].astype(jnp.float32)                  # [bk, D]
+        v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        k_pos = (meta_ref[1] + ki * block_k
-                 + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1))
-        mask = k_pos < meta_ref[2]
-        if causal:
-            mask = jnp.logical_and(mask, q_pos >= k_pos)
-        p = jnp.where(jnp.logical_and(mask, row_ok), jnp.exp(s - lse), 0.0)
+        if masked:
+            row_ok = lse > NEG_INF / 2                    # rows that attended
+            q_pos = (q_min + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0))
+            k_pos = (k_min + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1))
+            mask = k_pos < meta_ref[2]
+            if causal:
+                mask = jnp.logical_and(mask, q_pos >= k_pos)
+            p = jnp.where(jnp.logical_and(mask, row_ok),
+                          jnp.exp(s - lse), 0.0)
+        else:
+            p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
-        return dq + jax.lax.dot_general(
+        dq_ref[0] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    if causal:
-        # Same diagonal bound as the forward: K blocks past this q-block's
-        # max position contribute p == 0 — skip them.
-        q_max = meta_ref[0] + (qi + 1) * block_q - 1
-        hi = jnp.clip((q_max - meta_ref[1]) // block_k + 1, 0, num_k_blocks)
-    else:
-        hi = num_k_blocks
-    dq = jax.lax.fori_loop(
-        0, hi, body, jnp.zeros((block_q, q.shape[-1]), jnp.float32))
-    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+    @pl.when(jnp.logical_and(run, interior))
+    def _compute_interior():
+        _compute(masked=False)
+
+    @pl.when(jnp.logical_and(run, jnp.logical_not(interior)))
+    def _compute_boundary():
+        _compute(masked=True)
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finish():
+        # q was pre-scaled for s; the K-contraction needs one more scale.
+        dq_ref[0] = dq_ref[0] * scale
 
 
 def _bwd_dkv_kernel(meta_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, *, block_q: int, block_k: int,
                     num_q_blocks: int, causal: bool, scale: float):
-    """One (batch·head, k-block) program:
-    dv = Σ_q pᵀ·dO;  dk = Σ_q (p·(dp − Δ))ᵀ · (q·scale)."""
-    ki = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)                      # [bk, D]
-    v = v_ref[0].astype(jnp.float32)
-    d = k.shape[-1]
-    k_pos = (meta_ref[1] + ki * block_k
-             + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1))
-    k_valid = k_pos < meta_ref[2]
+    """One (batch·head, k-block, q-block) program:
+    dv += pᵀ·dO;  dk += (p·(dp − Δ))ᵀ·(q·scale).
 
-    def body(qi, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(
-            jnp.float32) * scale
-        do = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)][:, None]
-        delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)][:, None]
-        row_ok = lse > NEG_INF / 2
+    Same pipelined-grid layout as ``_bwd_dq_kernel`` with the roles
+    swapped: Q/dO/lse/Δ tiles stream per q-block while the f32 dk/dv
+    output blocks stay VMEM-resident across the qi sweep.
+    """
+    ki, qi = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_ref[0] = jnp.zeros_like(dk_ref[0])
+        dv_ref[0] = jnp.zeros_like(dv_ref[0])
+
+    # Same block classification as _bwd_dq_kernel: skip above-diagonal
+    # blocks; run interior (fully-below-diagonal, fully-valid) blocks
+    # mask-free.  Padded q rows carry lse = +1e30 so p == 0 masklessly.
+    q_min = meta_ref[0] + qi * block_q
+    q_max = q_min + block_q - 1
+    k_min = meta_ref[1] + ki * block_k
+    k_max = k_min + block_k - 1
+    run = (k_min <= q_max) if causal else True
+    interior = k_max < meta_ref[2]
+    if causal:
+        interior = jnp.logical_and(interior, k_max <= q_min)
+
+    def _compute(masked: bool):
+        k = k_ref[0].astype(jnp.float32)                  # [bk, D]
+        v = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32) * scale          # [bq, D]
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, 0, :][:, None]
+        delta = delta_ref[0, 0, :][:, None]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        q_pos = (meta_ref[0] + qi * block_q
-                 + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0))
-        mask = k_valid
-        if causal:
-            mask = jnp.logical_and(mask, q_pos >= k_pos)
-        p = jnp.where(jnp.logical_and(mask, row_ok), jnp.exp(s - lse), 0.0)
-        dv = dv + jax.lax.dot_general(
+        if masked:
+            row_ok = lse > NEG_INF / 2
+            q_pos = (q_min + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0))
+            k_pos = (k_min + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1))
+            mask = k_pos < meta_ref[2]
+            if causal:
+                mask = jnp.logical_and(mask, q_pos >= k_pos)
+            p = jnp.where(jnp.logical_and(mask, row_ok),
+                          jnp.exp(s - lse), 0.0)
+        else:
+            p = jnp.exp(s - lse)
+        dv_ref[0] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
         # q is pre-scaled, so this IS d s/d k contracted with ds.
-        dk = dk + jax.lax.dot_general(
+        dk_ref[0] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return dk, dv
 
-    dk0 = jnp.zeros((block_k, d), jnp.float32)
-    dv0 = jnp.zeros((block_k, d), jnp.float32)
-    if causal:
-        # Mirror bound: q blocks entirely BELOW this k-block's min position
-        # see only masked entries — start at the diagonal instead.
-        k_min = meta_ref[1] + ki * block_k
-        lo = jnp.clip((k_min - meta_ref[0]) // block_q, 0, num_q_blocks)
-    else:
-        lo = 0
-    dk, dv = jax.lax.fori_loop(lo, num_q_blocks, body, (dk0, dv0))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(jnp.logical_and(run, interior))
+    def _compute_interior():
+        _compute(masked=False)
+
+    @pl.when(jnp.logical_and(run, jnp.logical_not(interior)))
+    def _compute_boundary():
+        _compute(masked=True)
 
 
 def flash_attention_backward(q, k, v, dout, lse, delta, causal,
@@ -302,48 +407,54 @@ def flash_attention_backward(q, k, v, dout, lse, delta, causal,
     dq_kernel = functools.partial(
         _bwd_dq_kernel, block_q=block_q, block_k=block_k,
         num_k_blocks=num_k_blocks, causal=causal, scale=scale)
+    # Outputs accumulate in f32 in the VMEM-resident block (index maps
+    # constant over the innermost grid axis); cast back after the call.
     dq = pl.pallas_call(
         dq_kernel,
-        grid=(b * h, num_q_blocks),
+        grid=(b * h, num_q_blocks, num_k_blocks),
         in_specs=[
-            pl.BlockSpec((3,), lambda bh, qi: (0,), **smem),
-            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, kb.shape[1], d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, kb.shape[1], d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, 8, block_q), lambda bh, qi: (bh, 0, qi)),
-            pl.BlockSpec((1, 8, block_q), lambda bh, qi: (bh, 0, qi)),
+            pl.BlockSpec((3,), lambda bh, qi, ki: (0,), **smem),
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, 8, block_q), lambda bh, qi, ki: (bh, 0, qi)),
+            pl.BlockSpec((1, 8, block_q), lambda bh, qi, ki: (bh, 0, qi)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct(qb.shape, q.dtype),
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct(qb.shape, jnp.float32),
+        compiler_params=_dims_arbitrary_last(),
         interpret=interpret,
-    )(meta, qb, kb, vb, dob, lse_b, delta_b)
+    )(meta, qb, kb, vb, dob, lse_b, delta_b).astype(q.dtype)
 
     dkv_kernel = functools.partial(
         _bwd_dkv_kernel, block_q=block_q, block_k=block_k,
         num_q_blocks=num_q_blocks, causal=causal, scale=scale)
     dk, dv = pl.pallas_call(
         dkv_kernel,
-        grid=(b * h, num_k_blocks),
+        grid=(b * h, num_k_blocks, num_q_blocks),
         in_specs=[
-            pl.BlockSpec((3,), lambda bh, ki: (0,), **smem),
-            pl.BlockSpec((1, qb.shape[1], d), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, qb.shape[1], d), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((1, 8, qb.shape[1]), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((1, 8, qb.shape[1]), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((3,), lambda bh, ki, qi: (0,), **smem),
+            pl.BlockSpec((1, block_q, d), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, 8, block_q), lambda bh, ki, qi: (bh, 0, qi)),
+            pl.BlockSpec((1, 8, block_q), lambda bh, ki, qi: (bh, 0, qi)),
         ],
         out_specs=(
-            pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
         ),
         out_shape=(
-            jax.ShapeDtypeStruct(kb.shape, k.dtype),
-            jax.ShapeDtypeStruct(vb.shape, v.dtype),
+            jax.ShapeDtypeStruct(kb.shape, jnp.float32),
+            jax.ShapeDtypeStruct(vb.shape, jnp.float32),
         ),
+        compiler_params=_dims_arbitrary_last(),
         interpret=interpret,
     )(meta, qb, kb, vb, dob, lse_b, delta_b)
+    dk, dv = dk.astype(k.dtype), dv.astype(v.dtype)
 
     def from_bh(x, s):
         return x[:, :s].reshape(b, h, s, d).transpose(0, 2, 1, 3)
@@ -386,12 +497,13 @@ def flash_attention(q, k, v, causal: bool = True, q_offset=0, k_offset=0,
     ``q_offset``/``k_offset`` are global sequence positions of the first
     row/col (sequence-parallel shards pass shard_index × shard_len).
 
-    Block sizes bound the kernel's VMEM working set; a (512, 512) pair is
-    the measured throughput optimum on v5e at both S=1024 and S=8192
-    (docs/benchmarks.md round-2 sweep), while ``block_k`` ≥ 1024 overflows
-    the 16 MiB scoped-VMEM stack in the backward kernel at long S
-    ("Ran out of memory in memory space vmem") — stay at ≤512 unless you
-    re-derive the bound for your head_dim.
+    Block sizes bound the kernel's VMEM working set: all three kernels
+    stream K/V (or Q/dO) tiles through a pipelined 3-D grid, so the
+    footprint is one tile per operand plus the [block_q, block_k]
+    intermediates — independent of S (the round-2 whole-sequence layout
+    hit the 16 MiB scoped-VMEM wall at block_k ≥ 1024; this one compiles
+    to (1024, 2048) and beyond).  See docs/benchmarks.md for the measured
+    block sweep; defaults are the sweep optimum.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"

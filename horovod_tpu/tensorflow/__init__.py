@@ -151,7 +151,12 @@ def _allreduce_grad_value(grad, compression, sparse_as_dense,
 
 class _DistributedOptimizerV1(tf.compat.v1.train.Optimizer):
     """TF-1 optimizer wrapper: override ``compute_gradients`` to allreduce
-    (reference tensorflow/__init__.py:135-225)."""
+    (reference tensorflow/__init__.py:135-225).
+
+    ``Compression.int8`` here is EF-free: the per-step quantization
+    residual is dropped (best for short or quantization-robust runs).  The
+    torch and optax ``DistributedOptimizer`` wrappers carry error feedback;
+    use those when training length makes quantization bias a concern."""
 
     def __init__(self, optimizer, name=None, use_locking=False,
                  device_dense='', device_sparse='',
@@ -236,6 +241,16 @@ def DistributedOptimizer(optimizer, name=None, use_locking=False,
     the reference's design) or a keras-3 optimizer (eager/``model.fit``
     path; gradients — including ``tf.IndexedSlices`` from embedding layers
     — are allreduced inside ``apply``).
+
+    LIMITATION — host-plane binding: the collectives bridge into the
+    native engine through ``tf.py_function`` (tensorflow/mpi_ops.py),
+    which works in eager mode and inside ``tf.function`` (tested), but is
+    NOT serializable or XLA-compilable: a ``SavedModel`` export of a graph
+    containing these ops, or a ``jit_compile=True`` step wrapping them,
+    will fail.  Export the UNWRAPPED model (``model.save`` after training
+    works — the wrapper lives in the optimizer, not the layers), and keep
+    ``jit_compile`` off the distributed step.  TPU-compiled training
+    belongs to the JAX path (``horovod_tpu.DistributedOptimizer``).
     """
     if isinstance(optimizer, tf.compat.v1.train.Optimizer):
         return _DistributedOptimizerV1(
@@ -288,7 +303,11 @@ def DistributedGradientTape(gradtape, device_dense='', device_sparse='',
                             sparse_as_dense=False):
     """Wrap a ``tf.GradientTape`` so ``gradient()`` returns allreduced
     gradients — the TF-2 custom-training-loop analog of
-    ``DistributedOptimizer.compute_gradients``."""
+    ``DistributedOptimizer.compute_gradients``.
+
+    Same host-plane limitation as ``DistributedOptimizer``: the underlying
+    ``tf.py_function`` bridge is neither serializable (SavedModel) nor
+    XLA-compilable (``jit_compile=True``) — see that docstring."""
     return _DistributedGradientTape(gradtape, device_dense, device_sparse,
                                     compression, sparse_as_dense)
 

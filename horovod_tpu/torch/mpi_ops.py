@@ -17,6 +17,8 @@ mpi_ops.py:110-121), grad(allgather) = allreduce + this rank's dim-0 slice
 from __future__ import annotations
 
 import itertools
+import math
+from typing import NamedTuple
 
 import numpy as np
 import torch
@@ -123,32 +125,84 @@ def allreduce(tensor: torch.Tensor, average: bool = True,
     torch analog of the reference's ``tf.IndexedSlices`` handling
     (reference tensorflow/__init__.py:67-78)."""
     if tensor.is_sparse:
-        hi, hv = allreduce_sparse_async(tensor, name)
-        return synchronize_sparse(hi, hv, tensor.shape, average)
+        hs = allreduce_sparse_async(tensor, name, compression=compression)
+        return synchronize_sparse(hs, tensor.shape, average)
     if tensor.requires_grad:
         return _AllreduceFunction.apply(tensor, average, name, compression)
     return synchronize(allreduce_async(tensor, average, name, compression))
 
 
-def allreduce_sparse_async(tensor: torch.Tensor,
-                           name: str | None = None) -> tuple[int, int]:
-    """Start the sparse (gather-based) allreduce of a COO tensor; returns the
-    (indices, values) handle pair.  Per-rank nnz may differ — the engine's
-    ragged allgather carries dim-0 sizes like the reference's
-    ``MPI_Allgatherv`` response."""
+class SparseHandles(NamedTuple):
+    """Outstanding handles of one sparse (gather-based) allreduce.
+
+    ``scale``/``sizes`` are set only on the int8 wire: values travel as
+    int8 with ONE f32 scale per rank (the per-rank-scales scheme of the
+    engine's WIRE_INT8, core/qwire.py) plus a per-rank nnz gather so the
+    receiver can dequantize each rank's segment by its own scale."""
+
+    indices: int
+    values: int
+    scale: int | None
+    sizes: int | None
+    compression: object
+    ctx: object
+    values_dtype: torch.dtype
+
+
+def allreduce_sparse_async(tensor: torch.Tensor, name: str | None = None,
+                           compression=Compression.none) -> SparseHandles:
+    """Start the sparse (gather-based) allreduce of a COO tensor.  Per-rank
+    nnz may differ — the engine's ragged allgather carries dim-0 sizes like
+    the reference's ``MPI_Allgatherv`` response.
+
+    ``compression`` applies to the gathered VALUES (embedding-heavy models
+    are exactly where wire savings matter): fp16/bf16 cast on the wire
+    (reference torch/compression.py:42-63 semantics), or int8 with a
+    per-rank scale — a non-finite rank ships q=0 under its non-finite
+    scale, so overflow still surfaces as NaN after dequantization."""
     g = tensor.coalesce()
     name = _auto_name("allreduce.sparse", name)
     hi = allgather_async(g.indices().t().contiguous(), name=f"{name}.indices")
-    hv = allgather_async(g.values(), name=f"{name}.values")
-    return hi, hv
+    values = g.values()
+    if compression is Compression.int8:
+        v = values.detach().float()
+        amax = float(v.abs().max()) if v.numel() else 0.0
+        if math.isfinite(amax):
+            s = max(amax / 127.0, torch.finfo(torch.float32).tiny)
+            q = torch.clamp(torch.round(v / s), -127, 127).to(torch.int8)
+        else:
+            s = amax  # inf/nan scale: dequant restores non-finiteness
+            q = torch.zeros(v.shape, dtype=torch.int8)
+        hv = allgather_async(q, name=f"{name}.values")
+        hs = allgather_async(torch.tensor([s], dtype=torch.float32),
+                             name=f"{name}.scale")
+        hn = allgather_async(torch.tensor([v.shape[0] if v.ndim else 0],
+                                          dtype=torch.int32),
+                             name=f"{name}.nnz")
+        return SparseHandles(hi, hv, hs, hn, compression, None, values.dtype)
+    compressed, ctx = compression.compress(values)
+    hv = allgather_async(compressed, name=f"{name}.values")
+    return SparseHandles(hi, hv, None, None, compression, ctx, values.dtype)
 
 
-def synchronize_sparse(hi: int, hv: int, shape, average: bool = True
+def synchronize_sparse(handles: SparseHandles, shape, average: bool = True
                        ) -> torch.Tensor:
     """Complete an ``allreduce_sparse_async``: rebuild one COO tensor whose
     duplicate coordinates sum across ranks (coalesce = the reduction)."""
-    indices = synchronize(hi)
-    values = synchronize(hv)
+    indices = synchronize(handles.indices)
+    if handles.scale is not None:
+        q = synchronize(handles.values).float()
+        scales = synchronize(handles.scale).reshape(-1)
+        sizes = synchronize(handles.sizes).reshape(-1)
+        off = 0
+        for r in range(int(sizes.numel())):
+            nnz_r = int(sizes[r])
+            q[off:off + nnz_r] *= scales[r]
+            off += nnz_r
+        values = q.to(handles.values_dtype)
+    else:
+        values = handles.compression.decompress(synchronize(handles.values),
+                                                handles.ctx)
     if average:
         values = values / basics.size() if values.is_floating_point() \
             else torch.div(values, basics.size(), rounding_mode="trunc")

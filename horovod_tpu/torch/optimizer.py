@@ -11,6 +11,8 @@ handles land in the same cycle (the reference fusion-buffer win)."""
 
 from __future__ import annotations
 
+import math
+
 import torch
 
 from horovod_tpu.torch import mpi_ops
@@ -28,8 +30,14 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
     Sparse gradients (``nn.Embedding(sparse=True)``) are routed through the
     gather-based sparse allreduce automatically; ``sparse_as_dense=True``
     densifies them first instead (the reference's escape hatch,
-    tensorflow/__init__.py:197-199).  ``compression`` applies to dense
-    gradients only — the sparse gather path always ships native dtypes."""
+    tensorflow/__init__.py:197-199).  ``compression`` applies to sparse
+    values too (fp16/bf16 cast wire, or int8 with per-rank scales) —
+    embedding-heavy models get the same wire savings as dense ones.
+
+    ``Compression.int8`` carries per-parameter error feedback, like the
+    optax ``DistributedOptimizer``: each step's quantization residual is
+    added to the next step's gradient instead of being dropped, so long
+    runs accumulate no quantization bias."""
     return _DistributedOptimizer(optimizer, named_parameters, compression,
                                  backward_passes_per_step, sparse_as_dense)
 
@@ -46,6 +54,7 @@ class _DistributedOptimizer:
         self._bpps = max(backward_passes_per_step, 1)
         self._accum: dict[int, int] = {}          # id(param) → hook fires seen
         self._handles: dict[torch.nn.Parameter, tuple[int, object]] = {}
+        self._residuals: dict[int, torch.Tensor] = {}  # int8 error feedback
         self._hook_removers = []
 
         if named_parameters is not None:
@@ -89,26 +98,53 @@ class _DistributedOptimizer:
                         p.grad = grad.to_dense()
                     grad = p.grad
                 else:
-                    hi, hv = mpi_ops.allreduce_sparse_async(
-                        grad, name=f"DistributedOptimizer.{name}")
-                    self._handles[p] = ("sparse", hi, hv)
+                    hs = mpi_ops.allreduce_sparse_async(
+                        grad, name=f"DistributedOptimizer.{name}",
+                        compression=self._compression)
+                    self._handles[p] = ("sparse", hs)
                     return
             # Forward the compressor to the op layer: wire-format
             # compressors (Compression.int8) are routed there, not by the
             # compress() sandwich (which is an identity for them).
+            if self._compression is Compression.int8:
+                grad = self._int8_with_ef(p, grad)
             h = mpi_ops.allreduce_async(grad, average=True,
                                         name=f"DistributedOptimizer.{name}",
                                         compression=self._compression)
             self._handles[p] = h
         return hook
 
+    def _int8_with_ef(self, p, grad):
+        """Error feedback for the int8 wire, without engine surgery: add the
+        carried residual, quantize on the ENGINE'S OWN grid
+        (scale = max(amax/127, tiny) — core/qwire.py), keep the new residual,
+        and ship the dequantized f32 values.  The engine requantizes those
+        exactly: max|q| = 127 makes it re-derive the identical scale, so
+        q·s survives the wire bit-for-bit and the residual accounting holds.
+        """
+        with torch.no_grad():
+            g = grad.float()
+            e = self._residuals.get(id(p))
+            if e is not None:
+                g = g + e
+            amax = float(g.abs().max()) if g.numel() else 0.0
+            if not math.isfinite(amax):
+                # Non-finite step: reset the residual (a carried NaN would
+                # poison error feedback long after the loss scaler recovers)
+                # and ship as-is so the wire's NaN propagation fires.
+                self._residuals[id(p)] = torch.zeros_like(g)
+                return g
+            s = max(amax / 127.0, torch.finfo(torch.float32).tiny)
+            ship = torch.clamp(torch.round(g / s), -127, 127) * s
+            self._residuals[id(p)] = g - ship
+            return ship
+
     def synchronize(self):
         """Drain outstanding allreduces into ``.grad`` (reference
         torch/__init__.py:99-108)."""
         for p, h in list(self._handles.items()):
             if isinstance(h, tuple) and h[0] == "sparse":
-                _, hi, hv = h
-                p.grad = mpi_ops.synchronize_sparse(hi, hv, p.shape,
+                p.grad = mpi_ops.synchronize_sparse(h[1], p.shape,
                                                     average=True)
                 continue
             # mpi_ops.synchronize already ran the compressor's decompress.

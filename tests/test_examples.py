@@ -1,14 +1,45 @@
 """Example smoke tests — the reference runs its examples end-to-end in CI
 (.travis.yml:113-131, shrunk via sed); we do the same with tiny arguments
-on the virtual 8-chip mesh."""
+on the virtual 8-chip mesh, plus launcher-driven ``-np 2`` runs of the
+flagship examples (the reference's primary test mode, ``mpirun -np 2``)
+asserting rank-tagged output and identical final metrics on every rank."""
 
 import os
+import re
 import subprocess
 import sys
 
 import pytest
 
+from _timing import scaled
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_np2(script, *args, timeout=None):
+    """Run an example under the launcher (mpirun -np 2 analog)."""
+    env = {**os.environ, "PYTHONPATH": REPO}
+    env.pop("JAX_PLATFORMS", None)   # launcher pins cpu for children
+    out = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "2", "--",
+         sys.executable, os.path.join(REPO, "examples", script), *args],
+        capture_output=True, text=True,
+        timeout=timeout or scaled(420), env=env, cwd=REPO)
+    assert out.returncode == 0, (out.stdout[-3000:], out.stderr[-2000:])
+    return out.stdout
+
+
+def _final_metrics(out: str, np_: int = 2) -> dict[int, str]:
+    """Parse every rank's '[rank r/n] final ...' line; assert all present."""
+    vals: dict[int, str] = {}
+    for line in out.splitlines():
+        m = re.search(r"\[rank (\d+)/(\d+)\] final (.+)$", line)
+        if m:
+            assert int(m.group(2)) == np_
+            vals[int(m.group(1))] = m.group(3).strip()
+    assert set(vals) == set(range(np_)), \
+        f"missing rank-tagged finals in:\n{out[-2500:]}"
+    return vals
 
 
 def _run(script, *args, timeout=420):
@@ -102,3 +133,29 @@ def test_torch_mnist_resume(tmp_path):
     out = _run("torch_mnist.py", "--epochs", "2", "--ckpt-dir", ck)
     assert "resumed from epoch 0" in out
     assert "epoch 1:" in out and "epoch 0:" not in out
+
+
+# ---- launcher-driven multi-process runs (reference .travis.yml:113-131) ----
+
+def test_jax_mnist_np2(tmp_path):
+    out = _run_np2("jax_mnist.py", "--epochs", "1", "--batch-size", "4",
+                   "--ckpt-dir", str(tmp_path / "ck2"))
+    assert "[0]: " in out and "[1]: " in out   # launcher rank tagging
+    vals = _final_metrics(out)
+    assert vals[0] == vals[1], vals            # identical final metrics
+
+
+def test_torch_mnist_np2(tmp_path):
+    out = _run_np2("torch_mnist.py", "--epochs", "1",
+                   "--ckpt-dir", str(tmp_path / "tck2"))
+    assert "[0]: " in out and "[1]: " in out
+    vals = _final_metrics(out)
+    assert vals[0] == vals[1], vals
+
+
+def test_tensorflow_mnist_np2():
+    out = _run_np2("tensorflow_mnist.py", "--epochs", "1",
+                   "--batch-size", "32")
+    assert "[0]: " in out and "[1]: " in out
+    vals = _final_metrics(out)
+    assert vals[0] == vals[1], vals

@@ -471,3 +471,97 @@ EMPTY_WORKER = PRELUDE + textwrap.dedent("""
 
 def test_empty_and_ragged_64bit_allgather():
     _run_workers(EMPTY_WORKER, 2)
+
+
+# Rank-subset job (reference hvd.init(comm=[ranks]) sub-communicator,
+# common/__init__.py:58-84): 3 jax processes, horovod spans [0, 2] only.
+# Process 1 is refused by init(ranks=...) (no COMM_WORLD fallback) and
+# idles as a plain jax process while the members run engine + eager
+# collectives over the member-only device mesh.
+SUBSET_WORKER = textwrap.dedent("""
+    import os, sys, time
+    rank = int(sys.argv[1]); jport = int(sys.argv[2]); cport = int(sys.argv[3])
+    n = int(sys.argv[4])
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["HVD_TPU_COORDINATOR_HOST"] = "127.0.0.1"
+    os.environ["HVD_TPU_COORDINATOR_PORT"] = str(cport)
+    os.environ["HVD_TPU_EXECUTOR"] = "multihost"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import horovod_tpu as hvd
+
+    members = [0, 2]
+    done = [f"/tmp/hvd_subset_{jport}_{r}.done" for r in members]
+    if rank not in members:
+        try:
+            hvd.init(coordinator_address=f"127.0.0.1:{jport}",
+                     num_processes=n, process_id=rank, ranks=members)
+            raise SystemExit("non-member init did not raise")
+        except ValueError as e:
+            assert "not in" in str(e), e
+        # Keep the jax.distributed client alive until members finish (an
+        # early exit would tear down the coordination service under them).
+        deadline = time.time() + 240
+        while not all(os.path.exists(p) for p in done):
+            if time.time() > deadline:
+                raise SystemExit("members never finished")
+            time.sleep(0.5)
+        print(f"RANK{rank} OK", flush=True)
+        raise SystemExit(0)
+
+    hvd.init(coordinator_address=f"127.0.0.1:{jport}", num_processes=n,
+             process_id=rank, ranks=members)
+    me = members.index(rank)
+    assert hvd.size() == len(members) and hvd.rank() == me
+    assert hvd.num_chips() == len(members)  # member devices only
+
+    # engine allreduce across members only: sum of (subset_rank+1)
+    S = sum(r + 1 for r in range(len(members)))
+    h = hvd.allreduce_async(np.full(5, float(me + 1), np.float32),
+                            average=False, name="sub.ar")
+    np.testing.assert_allclose(hvd.synchronize(h), np.full(5, float(S)))
+
+    # int8 wire across the member mesh
+    h = hvd.allreduce_async(np.full(8, float(me + 1), np.float32),
+                            average=False, name="sub.q8",
+                            compression=hvd.Compression.int8)
+    np.testing.assert_allclose(hvd.synchronize(h), np.full(8, float(S)),
+                               rtol=0.02)
+
+    # broadcast from subset-rank 1 (jax process 2)
+    h = hvd.broadcast_async(np.full(3, float(me * 11), np.float32),
+                            root_rank=1, name="sub.bc")
+    np.testing.assert_allclose(hvd.synchronize(h), np.full(3, 11.0))
+
+    # ragged engine allgather: member m contributes m+1 rows
+    h = hvd.allgather_async(np.full((me + 1, 2), float(me), np.float32),
+                            name="sub.ag")
+    out = hvd.synchronize(h)
+    assert out.shape == (S, 2), out.shape
+
+    # eager op layer + object broadcast over the member mesh
+    out = hvd.allreduce(np.full(4, float(me + 1), np.float32), average=True)
+    np.testing.assert_allclose(np.asarray(out), np.full(4, S / len(members)))
+    obj = hvd.broadcast_object({"from": "root"} if me == 0 else None)
+    assert obj == {"from": "root"}
+
+    # the legacy full-job transport must refuse subset jobs loudly
+    os.environ["HVD_TPU_EAGER_REDUCE"] = "gather"
+    try:
+        hvd.allreduce(np.ones(2, np.float32))
+        raise SystemExit("legacy transport did not refuse the subset")
+    except NotImplementedError as e:
+        assert "subset" in str(e), e
+    finally:
+        del os.environ["HVD_TPU_EAGER_REDUCE"]
+
+    hvd.barrier(name="sub.done")
+    open(f"/tmp/hvd_subset_{jport}_{rank}.done", "w").close()
+    print(f"RANK{rank} OK", flush=True)
+""")
+
+
+def test_rank_subset_job():
+    _run_workers(SUBSET_WORKER, 3)

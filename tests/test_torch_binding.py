@@ -185,6 +185,37 @@ def test_allreduce_sparse_roundtrip(hvdt):
     torch.testing.assert_close(out.to_dense(), dense)
 
 
+@pytest.mark.parametrize("comp,vdtype", [
+    ("fp16", torch.float32), ("bf16", torch.float32),
+    ("int8", torch.float32), ("int8", torch.float16),
+    ("int8", torch.bfloat16), ("none", torch.float64),
+])
+def test_allreduce_sparse_compression_matrix(hvdt, comp, vdtype):
+    """Sparse values ride the compressed wire (fp16/bf16 cast, or int8 with
+    per-rank scales) instead of always-native dtypes — the embedding-path
+    wire saving the dense path already had."""
+    compression = getattr(hvdt.Compression, comp)
+    dense = torch.zeros(8, 4, dtype=vdtype)
+    dense[2] = torch.arange(4, dtype=vdtype) * 0.25
+    dense[5] = -1.5
+    sp = dense.to_sparse_coo()
+    out = hvdt.allreduce(sp, average=True, compression=compression)
+    assert out.is_sparse
+    tol = 1e-2 if comp in ("fp16", "bf16", "int8") else 1e-6
+    torch.testing.assert_close(out.to_dense().float(), dense.float(),
+                               atol=tol, rtol=tol)
+
+
+def test_sparse_int8_nan_propagates(hvdt):
+    """A non-finite sparse gradient ships q=0 under a non-finite scale, so
+    the dequantized values are NaN — overflow is never laundered."""
+    dense = torch.zeros(4, 2)
+    dense[1] = float("nan")
+    out = hvdt.allreduce(dense.to_sparse_coo(), average=False,
+                         compression=hvdt.Compression.int8)
+    assert not torch.isfinite(out.to_dense()[1]).all()
+
+
 def test_distributed_optimizer_sparse_embedding(hvdt):
     # nn.Embedding(sparse=True) gradients must route through the
     # gather-based sparse path automatically (reference routes IndexedSlices
