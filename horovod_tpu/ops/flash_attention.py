@@ -41,30 +41,31 @@ NEG_INF = -1e30
 
 
 def _flash_kernel(meta_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref,
-                  l_ref, *, block_q: int, block_k: int, num_k_blocks: int,
-                  causal: bool, scale: float):
-    """One (batch·head, q-block, k-block) program: online softmax with the
-    K-block axis as a GRID dimension — Pallas streams each (block_k, D)
-    K/V tile HBM→VMEM double-buffered, and the (m, l, acc) carry lives in
-    VMEM-resident output blocks (index maps constant in ki), so scoped
-    VMEM is one tile of each operand plus the [bq, bk] intermediates,
+                  l_ref, *, block_q: int, block_k: int, sub_k: int,
+                  num_k_blocks: int, causal: bool, scale: float):
+    """One (batch·head, q-block, K-super-tile) program: online softmax.
+
+    Two-level streaming: the grid's K axis moves (block_k, D) SUPER tiles
+    HBM→VMEM double-buffered (few grid steps → the per-step fixed cost is
+    amortized), while an in-kernel fori loop computes over (block_q,
+    sub_k) SUB tiles so the [bq, sub_k] intermediates stay small.  Scoped
+    VMEM is one super tile of K/V plus the sub-tile intermediates —
     independent of S.
 
     meta_ref (SMEM int32[3]): [q_offset, k_offset, k_len] — global position
     offsets (sequence parallelism) and the unpadded K length.
 
-    INTERIOR K blocks (entirely below the causal diagonal and entirely
-    inside the valid K range) take a mask-free body: no iota/compare/
-    select per element — only the diagonal and boundary blocks pay for
-    masking.  At long S that is ~all blocks exempted, which matters
-    because the mask arithmetic runs on the VPU while the matmuls it
-    brackets run on the MXU.
+    The sub-tile loop is SPLIT: an interior prefix (entirely below the
+    causal diagonal and inside the valid K range) runs a mask-free body —
+    no per-element iota/compare/select (VPU work bracketing the MXU
+    matmuls) — and only the diagonal/boundary suffix pays for masking.
 
     ``m_ref``/``l_ref`` are carry storage in the lse layout (sublane-
     replicated (8, block_q)); callers discard them.  ``o_ref`` is f32
     (accumulation precision); the caller casts.
     """
     qi, ki = pl.program_id(1), pl.program_id(2)
+    nsub = block_k // sub_k
 
     @pl.when(ki == 0)
     def _init():
@@ -74,27 +75,33 @@ def _flash_kernel(meta_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref,
 
     q_min = meta_ref[0] + qi * block_q
     q_max = q_min + block_q - 1
-    k_min = meta_ref[1] + ki * block_k
-    k_max = k_min + block_k - 1
-    run = (k_min <= q_max) if causal else True
-    interior = k_max < meta_ref[2]
+    ks_min = meta_ref[1] + ki * block_k   # super-tile base position
+    # Sub-tile bounds (scalar arithmetic on SMEM values):
     if causal:
-        interior = jnp.logical_and(interior, k_max <= q_min)
+        hi = jnp.clip((q_max - ks_min) // sub_k + 1, 0, nsub)
+    else:
+        hi = nsub
+    valid_end = (meta_ref[2] - ks_min) // sub_k
+    if causal:
+        interior_end = jnp.minimum((q_min - ks_min + 1) // sub_k, valid_end)
+    else:
+        interior_end = valid_end
+    interior_end = jnp.clip(interior_end, 0, hi)
 
-    def _compute(masked: bool):
-        q = q_ref[0].astype(jnp.float32) * scale          # [bq, D]
-        k = k_ref[0]                                      # [bk, D]
-        v = v_ref[0]
-        m = m_ref[0, 0, :][:, None]                       # [bq, 1]
-        l = l_ref[0, 0, :][:, None]
+    q = q_ref[0].astype(jnp.float32) * scale              # [bq, D]
+
+    def body(si, carry, masked):
+        m, l = carry
+        k = k_ref[0, pl.ds(si * sub_k, sub_k), :]         # [sk, D]
+        v = v_ref[0, pl.ds(si * sub_k, sub_k), :]
         s = jax.lax.dot_general(
             q, k.astype(jnp.float32), (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)           # [bq, bk]
+            preferred_element_type=jnp.float32)           # [bq, sk]
         if masked:
             q_pos = (q_min + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0))
-            k_pos = (k_min + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1))
+                jnp.int32, (block_q, sub_k), 0))
+            k_pos = (ks_min + si * sub_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, sub_k), 1))
             mask = k_pos < meta_ref[2]                    # padding mask
             if causal:
                 mask = jnp.logical_and(mask, q_pos >= k_pos)
@@ -109,16 +116,35 @@ def _flash_kernel(meta_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref,
             p, v.astype(jnp.float32), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         o_ref[0] = o_ref[0] * corr + pv
-        m_ref[0] = jnp.broadcast_to(m_new[:, 0][None, :], m_ref.shape[1:])
-        l_ref[0] = jnp.broadcast_to(l_new[:, 0][None, :], l_ref.shape[1:])
+        return m_new, l_new
 
-    @pl.when(jnp.logical_and(run, interior))
-    def _compute_interior():
-        _compute(masked=False)
+    def _writeback(m, l):
+        m_ref[0] = jnp.broadcast_to(m[:, 0][None, :], m_ref.shape[1:])
+        l_ref[0] = jnp.broadcast_to(l[:, 0][None, :], l_ref.shape[1:])
 
-    @pl.when(jnp.logical_and(run, jnp.logical_not(interior)))
-    def _compute_boundary():
-        _compute(masked=True)
+    if nsub == 1:
+        # Static single-tile case (the measured optimum): straight-line
+        # bodies under pl.when — a dynamic-bound fori_loop here defeats
+        # Mosaic's scheduling and costs ~5 MFU points (docs/benchmarks.md).
+        run = hi >= 1
+        interior = interior_end >= 1
+
+        @pl.when(jnp.logical_and(run, interior))
+        def _one_interior():
+            _writeback(*body(0, (m_ref[0, 0, :][:, None],
+                                 l_ref[0, 0, :][:, None]), masked=False))
+
+        @pl.when(jnp.logical_and(run, jnp.logical_not(interior)))
+        def _one_boundary():
+            _writeback(*body(0, (m_ref[0, 0, :][:, None],
+                                 l_ref[0, 0, :][:, None]), masked=True))
+    else:
+        carry = (m_ref[0, 0, :][:, None], l_ref[0, 0, :][:, None])
+        carry = jax.lax.fori_loop(
+            0, interior_end, functools.partial(body, masked=False), carry)
+        m, l = jax.lax.fori_loop(
+            interior_end, hi, functools.partial(body, masked=True), carry)
+        _writeback(m, l)
 
     @pl.when(ki == num_k_blocks - 1)
     def _finish():
@@ -153,48 +179,63 @@ def _pad_to(x, axis, multiple):
     return jnp.pad(x, widths)
 
 
+def _sub_fit(block: int, sub: int) -> tuple[int, int]:
+    """Clamp the compute sub-tile to the (super) block and make the block a
+    multiple of it."""
+    sub = min(sub, block)
+    return max(block // sub, 1) * sub, sub
+
+
 def _flash_forward(q, k, v, causal, q_offset, k_offset, block_q, block_k,
-                   interpret, *, with_lse: bool = False):
+                   interpret, *, sub: int = 1024, with_lse: bool = False):
     b, s_q, h, d = q.shape
     s_k = k.shape[1]
     scale = d ** -0.5
+    block_k, sub_k = _sub_fit(block_k, sub)
     # [B, S, H, D] → [B·H, S, D]
     def to_bh(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
 
     qb = _pad_to(to_bh(q), 1, block_q)
-    kb = _pad_to(to_bh(k), 1, block_k)
-    vb = _pad_to(to_bh(v), 1, block_k)
-    num_q_blocks = qb.shape[1] // block_q
-    num_k_blocks = kb.shape[1] // block_k
+    smem = {"memory_space": _SMEM} if _SMEM is not None else {}
     meta = jnp.asarray(
         [jnp.asarray(q_offset, jnp.int32),
          jnp.asarray(k_offset, jnp.int32),
          jnp.asarray(k_offset, jnp.int32) + s_k], jnp.int32)
-
-    kernel = functools.partial(
-        _flash_kernel, block_q=block_q, block_k=block_k,
-        num_k_blocks=num_k_blocks, causal=causal, scale=scale)
-    smem = {"memory_space": _SMEM} if _SMEM is not None else {}
+    num_q_blocks = qb.shape[1] // block_q
     carry_shape = jax.ShapeDtypeStruct((qb.shape[0], 8, qb.shape[1]),
                                        jnp.float32)
+
+    kb = _pad_to(to_bh(k), 1, block_k)
+    vb = _pad_to(to_bh(v), 1, block_k)
+    num_k_blocks = kb.shape[1] // block_k
+    kernel = functools.partial(
+        _flash_kernel, block_q=block_q, block_k=block_k, sub_k=sub_k,
+        num_k_blocks=num_k_blocks, causal=causal, scale=scale)
     out, lse, _m, _l = pl.pallas_call(
         kernel,
         grid=(b * h, num_q_blocks, num_k_blocks),
         in_specs=[
             pl.BlockSpec((3,), lambda bh, qi, ki: (0,), **smem),
-            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_q, d),
+                         lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, qi, ki: (bh, ki, 0)),
         ],
         out_specs=(
-            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, 8, block_q), lambda bh, qi, ki: (bh, 0, qi)),
-            pl.BlockSpec((1, 8, block_q), lambda bh, qi, ki: (bh, 0, qi)),
-            pl.BlockSpec((1, 8, block_q), lambda bh, qi, ki: (bh, 0, qi)),
+            pl.BlockSpec((1, block_q, d),
+                         lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, 8, block_q),
+                         lambda bh, qi, ki: (bh, 0, qi)),
+            pl.BlockSpec((1, 8, block_q),
+                         lambda bh, qi, ki: (bh, 0, qi)),
+            pl.BlockSpec((1, 8, block_q),
+                         lambda bh, qi, ki: (bh, 0, qi)),
         ),
         out_shape=(
-            jax.ShapeDtypeStruct(qb.shape, jnp.float32),  # f32 accumulator
+            jax.ShapeDtypeStruct(qb.shape, jnp.float32),  # f32 acc
             carry_shape,   # lse
             carry_shape,   # m carry (discarded)
             carry_shape,   # l carry (discarded)
@@ -202,7 +243,8 @@ def _flash_forward(q, k, v, causal, q_offset, k_offset, block_q, block_k,
         compiler_params=_dims_arbitrary_last(),
         interpret=interpret,
     )(meta, qb, kb, vb)
-    out = out.astype(q.dtype)[:, :s_q].reshape(b, h, s_q, d)
+    out = out.astype(q.dtype)
+    out = out[:, :s_q].reshape(b, h, s_q, d)
     out = out.transpose(0, 2, 1, 3)
     if with_lse:
         # [B·H, 8, S] (sublane-replicated) → [B, S, H]
@@ -212,57 +254,59 @@ def _flash_forward(q, k, v, causal, q_offset, k_offset, block_q, block_k,
 
 
 def _bwd_dq_kernel(meta_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, *, block_q: int, block_k: int, num_k_blocks: int,
-                   causal: bool, scale: float):
-    """One (batch·head, q-block, k-block) program: dq += p·(dp − Δ)·K.
+                   dq_ref, *, block_q: int, block_k: int, sub_k: int,
+                   num_k_blocks: int, causal: bool, scale: float):
+    """One (batch·head, q-block, K-super-tile) program: dq += p·(dp − Δ)·K.
 
-    The k-block axis is a GRID dimension, not an in-kernel loop: Pallas
-    streams each (block_k, D) K/V tile HBM→VMEM double-buffered, and the
-    f32 dq output block (index map constant in ki) stays VMEM-resident as
-    the accumulator across the ki sweep.  Scoped VMEM is one tile of each
-    operand plus the [bq, bk] intermediates — independent of S, which is
-    what lets block_k ≥ 1024 compile where the round-2 whole-sequence
-    layout overflowed the 16 MiB VMEM bound at S=8192.
+    Same two-level streaming as the forward: the grid moves (block_k, D)
+    K/V super tiles double-buffered while the in-kernel loop computes
+    (block_q, sub_k) sub tiles; the f32 dq output block (index map
+    constant in ki) stays VMEM-resident as the accumulator.  Scoped VMEM
+    is independent of S — what lets large tiles compile where the round-2
+    whole-sequence layout overflowed the 16 MiB bound at S=8192.
+
+    The sub-tile loop splits into a mask-free interior prefix and a masked
+    diagonal/boundary suffix (padded q rows are safe maskless: their lse
+    is +1e30, so p = exp(s - lse) == 0); super tiles entirely above the
+    diagonal run zero sub-tiles.
     """
     qi, ki = pl.program_id(1), pl.program_id(2)
+    nsub = block_k // sub_k
 
     @pl.when(ki == 0)
     def _init():
         dq_ref[0] = jnp.zeros_like(dq_ref[0])
 
-    # Block classification (positions are SMEM scalars, so this is scalar
-    # arithmetic): blocks entirely above the diagonal contribute p == 0 —
-    # skip their compute (their tiles still stream; attention here is
-    # MXU-bound, so masked-out compute, not fetch, is the cost that
-    # counts).  INTERIOR blocks — entirely below the diagonal and inside
-    # the valid K range — take a mask-free body: no per-element iota/
-    # compare/select (VPU work bracketing the MXU matmuls); only diagonal
-    # and boundary blocks pay for masking.  Padded q rows are safe
-    # maskless: their lse is +1e30, so p = exp(s - lse) == 0.
     q_min = meta_ref[0] + qi * block_q
     q_max = q_min + block_q - 1
-    k_min = meta_ref[1] + ki * block_k
-    k_max = k_min + block_k - 1
-    run = (k_min <= q_max) if causal else True
-    interior = k_max < meta_ref[2]
+    ks_min = meta_ref[1] + ki * block_k
     if causal:
-        interior = jnp.logical_and(interior, k_max <= q_min)
+        hi = jnp.clip((q_max - ks_min) // sub_k + 1, 0, nsub)
+    else:
+        hi = nsub
+    valid_end = (meta_ref[2] - ks_min) // sub_k
+    if causal:
+        interior_end = jnp.minimum((q_min - ks_min + 1) // sub_k, valid_end)
+    else:
+        interior_end = valid_end
+    interior_end = jnp.clip(interior_end, 0, hi)
 
-    def _compute(masked: bool):
-        q = q_ref[0].astype(jnp.float32) * scale          # [bq, D]
-        do = do_ref[0].astype(jnp.float32)                # [bq, D]
-        lse = lse_ref[0, 0, :][:, None]                   # [bq, 1]
-        delta = delta_ref[0, 0, :][:, None]
-        k = k_ref[0].astype(jnp.float32)                  # [bk, D]
-        v = v_ref[0].astype(jnp.float32)
+    q = q_ref[0].astype(jnp.float32) * scale              # [bq, D]
+    do = do_ref[0].astype(jnp.float32)                    # [bq, D]
+    lse = lse_ref[0, 0, :][:, None]                       # [bq, 1]
+    delta = delta_ref[0, 0, :][:, None]
+
+    def body(si, carry, masked):
+        k = k_ref[0, pl.ds(si * sub_k, sub_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(si * sub_k, sub_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if masked:
             row_ok = lse > NEG_INF / 2                    # rows that attended
             q_pos = (q_min + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0))
-            k_pos = (k_min + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1))
+                jnp.int32, (block_q, sub_k), 0))
+            k_pos = (ks_min + si * sub_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, sub_k), 1))
             mask = k_pos < meta_ref[2]
             if causal:
                 mask = jnp.logical_and(mask, q_pos >= k_pos)
@@ -276,14 +320,25 @@ def _bwd_dq_kernel(meta_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+        return carry
 
-    @pl.when(jnp.logical_and(run, interior))
-    def _compute_interior():
-        _compute(masked=False)
+    if nsub == 1:
+        # Static single-tile case: straight-line pl.when (see _flash_kernel).
+        run = hi >= 1
+        interior = interior_end >= 1
 
-    @pl.when(jnp.logical_and(run, jnp.logical_not(interior)))
-    def _compute_boundary():
-        _compute(masked=True)
+        @pl.when(jnp.logical_and(run, interior))
+        def _one_interior():
+            body(0, 0, masked=False)
+
+        @pl.when(jnp.logical_and(run, jnp.logical_not(interior)))
+        def _one_boundary():
+            body(0, 0, masked=True)
+    else:
+        jax.lax.fori_loop(0, interior_end,
+                          functools.partial(body, masked=False), 0)
+        jax.lax.fori_loop(interior_end, hi,
+                          functools.partial(body, masked=True), 0)
 
     @pl.when(ki == num_k_blocks - 1)
     def _finish():
@@ -293,48 +348,64 @@ def _bwd_dq_kernel(meta_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_dkv_kernel(meta_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, *, block_q: int, block_k: int,
-                    num_q_blocks: int, causal: bool, scale: float):
-    """One (batch·head, k-block, q-block) program:
+                    sub_q: int, num_q_blocks: int, causal: bool,
+                    scale: float):
+    """One (batch·head, k-block, Q-super-tile) program:
     dv += pᵀ·dO;  dk += (p·(dp − Δ))ᵀ·(q·scale).
 
-    Same pipelined-grid layout as ``_bwd_dq_kernel`` with the roles
-    swapped: Q/dO/lse/Δ tiles stream per q-block while the f32 dk/dv
+    The forward/dq layout with the roles swapped: the grid streams
+    (block_q, D) Q/dO super tiles (lse/Δ alongside) double-buffered while
+    the in-kernel loop computes (sub_q, block_k) sub tiles; the f32 dk/dv
     output blocks stay VMEM-resident across the qi sweep.
+
+    Sub-tile split mirrors the others, from the K block's point of view:
+    q sub-tiles entirely ABOVE the diagonal (q_sub_max < k_min) are
+    skipped; the diagonal band runs masked; q sub-tiles entirely below
+    (q_sub_min >= k_max, with the K block fully valid) run mask-free —
+    padded q rows are safe maskless (lse = +1e30 ⇒ p = 0).
     """
     ki, qi = pl.program_id(1), pl.program_id(2)
+    nsub = block_q // sub_q
 
     @pl.when(qi == 0)
     def _init():
         dk_ref[0] = jnp.zeros_like(dk_ref[0])
         dv_ref[0] = jnp.zeros_like(dv_ref[0])
 
-    # Same block classification as _bwd_dq_kernel: skip above-diagonal
-    # blocks; run interior (fully-below-diagonal, fully-valid) blocks
-    # mask-free.  Padded q rows carry lse = +1e30 so p == 0 masklessly.
-    q_min = meta_ref[0] + qi * block_q
-    q_max = q_min + block_q - 1
+    qs_min = meta_ref[0] + qi * block_q   # super-tile base position
     k_min = meta_ref[1] + ki * block_k
     k_max = k_min + block_k - 1
-    run = (k_min <= q_max) if causal else True
-    interior = k_max < meta_ref[2]
     if causal:
-        interior = jnp.logical_and(interior, k_max <= q_min)
+        # First sub-tile whose q_sub_max >= k_min.
+        lo = jnp.clip((k_min - qs_min) // sub_q, 0, nsub)
+        # First sub-tile with q_sub_min >= k_max (mask-free from there on).
+        int_start = jnp.clip(-((qs_min - k_max) // sub_q), 0, nsub)
+    else:
+        lo = jnp.int32(0)
+        int_start = jnp.int32(0)
+    k_valid = k_max < meta_ref[2]
+    # An invalid K block (padding columns) needs the padding mask in every
+    # sub-tile: push the interior start past the end.
+    int_start = jnp.where(k_valid, int_start, nsub)
+    int_start = jnp.maximum(int_start, lo)
 
-    def _compute(masked: bool):
-        k = k_ref[0].astype(jnp.float32)                  # [bk, D]
-        v = v_ref[0].astype(jnp.float32)
-        q = q_ref[0].astype(jnp.float32) * scale          # [bq, D]
-        do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0, 0, :][:, None]
-        delta = delta_ref[0, 0, :][:, None]
+    k = k_ref[0].astype(jnp.float32)                      # [bk, D]
+    v = v_ref[0].astype(jnp.float32)
+
+    def body(si, carry, masked):
+        q = q_ref[0, pl.ds(si * sub_q, sub_q), :].astype(
+            jnp.float32) * scale                          # [sq, D]
+        do = do_ref[0, pl.ds(si * sub_q, sub_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(si * sub_q, sub_q)][:, None]
+        delta = delta_ref[0, 0, pl.ds(si * sub_q, sub_q)][:, None]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if masked:
             row_ok = lse > NEG_INF / 2
-            q_pos = (q_min + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0))
+            q_pos = (qs_min + si * sub_q + jax.lax.broadcasted_iota(
+                jnp.int32, (sub_q, block_k), 0))
             k_pos = (k_min + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1))
+                jnp.int32, (sub_q, block_k), 1))
             mask = k_pos < meta_ref[2]
             if causal:
                 mask = jnp.logical_and(mask, q_pos >= k_pos)
@@ -352,19 +423,30 @@ def _bwd_dkv_kernel(meta_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_ref[0] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+        return carry
 
-    @pl.when(jnp.logical_and(run, interior))
-    def _compute_interior():
-        _compute(masked=False)
+    if nsub == 1:
+        # Static single-tile case: straight-line pl.when (see _flash_kernel).
+        run = lo < 1
+        interior = int_start < 1
 
-    @pl.when(jnp.logical_and(run, jnp.logical_not(interior)))
-    def _compute_boundary():
-        _compute(masked=True)
+        @pl.when(jnp.logical_and(run, jnp.logical_not(interior)))
+        def _one_boundary():
+            body(0, 0, masked=True)
+
+        @pl.when(interior)
+        def _one_interior():
+            body(0, 0, masked=False)
+    else:
+        jax.lax.fori_loop(lo, int_start,
+                          functools.partial(body, masked=True), 0)
+        jax.lax.fori_loop(int_start, nsub,
+                          functools.partial(body, masked=False), 0)
 
 
 def flash_attention_backward(q, k, v, dout, lse, delta, causal,
                              q_offset, k_offset, block_q, block_k,
-                             interpret):
+                             interpret, sub: int = 1024):
     """Fused backward: (dq, dk, dv) from saved lse and Δ = rowsum(dO·O).
 
     ``lse``/``delta``: [B, S_q, H] float32 — from ``_flash_forward(...,
@@ -374,6 +456,17 @@ def flash_attention_backward(q, k, v, dout, lse, delta, causal,
     b, s_q, h, d = q.shape
     s_k = k.shape[1]
     scale = d ** -0.5
+    block_q, sub_q = _sub_fit(block_q, sub)
+    block_k, sub_k = _sub_fit(block_k, sub)
+    # The dk/dv pass's k tile is BOTH its resident accumulator width and
+    # its compute-tile width (intermediates are [sub_q, k_tile]) — cap it
+    # near 1024 (keeping the s/p/dp/ds buffers ~2 MB) instead of letting
+    # it scale with the streaming super-tile chosen for the fwd/dq passes,
+    # while keeping it a divisor of the padded K length.
+    bk_dkv = sub_k
+    while (bk_dkv * 2 <= min(block_k, max(1024, sub_k))
+           and block_k % (bk_dkv * 2) == 0):
+        bk_dkv *= 2
 
     def to_bh(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
@@ -405,7 +498,7 @@ def flash_attention_backward(q, k, v, dout, lse, delta, causal,
     smem = {"memory_space": _SMEM} if _SMEM is not None else {}
 
     dq_kernel = functools.partial(
-        _bwd_dq_kernel, block_q=block_q, block_k=block_k,
+        _bwd_dq_kernel, block_q=block_q, block_k=block_k, sub_k=sub_k,
         num_k_blocks=num_k_blocks, causal=causal, scale=scale)
     # Outputs accumulate in f32 in the VMEM-resident block (index maps
     # constant over the innermost grid axis); cast back after the call.
@@ -428,24 +521,25 @@ def flash_attention_backward(q, k, v, dout, lse, delta, causal,
         interpret=interpret,
     )(meta, qb, kb, vb, dob, lse_b, delta_b).astype(q.dtype)
 
+    num_k_dkv = kb.shape[1] // bk_dkv
     dkv_kernel = functools.partial(
-        _bwd_dkv_kernel, block_q=block_q, block_k=block_k,
+        _bwd_dkv_kernel, block_q=block_q, block_k=bk_dkv, sub_q=sub_q,
         num_q_blocks=num_q_blocks, causal=causal, scale=scale)
     dk, dv = pl.pallas_call(
         dkv_kernel,
-        grid=(b * h, num_k_blocks, num_q_blocks),
+        grid=(b * h, num_k_dkv, num_q_blocks),
         in_specs=[
             pl.BlockSpec((3,), lambda bh, ki, qi: (0,), **smem),
             pl.BlockSpec((1, block_q, d), lambda bh, ki, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, bk_dkv, d), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, bk_dkv, d), lambda bh, ki, qi: (bh, ki, 0)),
             pl.BlockSpec((1, block_q, d), lambda bh, ki, qi: (bh, qi, 0)),
             pl.BlockSpec((1, 8, block_q), lambda bh, ki, qi: (bh, 0, qi)),
             pl.BlockSpec((1, 8, block_q), lambda bh, ki, qi: (bh, 0, qi)),
         ],
         out_specs=(
-            pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, bk_dkv, d), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, bk_dkv, d), lambda bh, ki, qi: (bh, ki, 0)),
         ),
         out_shape=(
             jax.ShapeDtypeStruct(kb.shape, jnp.float32),
@@ -462,27 +556,28 @@ def flash_attention_backward(q, k, v, dout, lse, delta, causal,
     return from_bh(dq, s_q), from_bh(dk, s_k), from_bh(dv, s_k)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 6, 7, 8))
-def _flash(q, k, v, causal, q_offset, k_offset, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 6, 7, 8, 9))
+def _flash(q, k, v, causal, q_offset, k_offset, block_q, block_k, sub,
+           interpret):
     return _flash_forward(q, k, v, causal, q_offset, k_offset, block_q,
-                          block_k, interpret)
+                          block_k, interpret, sub=sub)
 
 
-def _flash_fwd(q, k, v, causal, q_offset, k_offset, block_q, block_k,
+def _flash_fwd(q, k, v, causal, q_offset, k_offset, block_q, block_k, sub,
                interpret):
     out, lse = _flash_forward(q, k, v, causal, q_offset, k_offset, block_q,
-                              block_k, interpret, with_lse=True)
+                              block_k, interpret, sub=sub, with_lse=True)
     return out, (q, k, v, out, lse, q_offset, k_offset)
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, res, g):
+def _flash_bwd(causal, block_q, block_k, sub, interpret, res, g):
     q, k, v, out, lse, q_offset, k_offset = res
     # Δ = rowsum(dO·O) — the softmax-normalization term of the backward.
     # [B, S, H, D] → [B, S, H], matching the lse layout.
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     dq, dk, dv = flash_attention_backward(
         q, k, v, g, lse, delta, causal, q_offset, k_offset, block_q,
-        block_k, interpret)
+        block_k, interpret, sub=sub)
     return dq, dk, dv, None, None
 
 
@@ -490,32 +585,33 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, causal: bool = True, q_offset=0, k_offset=0,
-                    block_q: int = 128, block_k: int = 128,
-                    interpret: bool | None = None):
+                    block_q: int = 1024, block_k: int = 1024,
+                    sub: int = 1024, interpret: bool | None = None):
     """Fused attention over [B, S, H, D] tensors.
 
     ``q_offset``/``k_offset`` are global sequence positions of the first
     row/col (sequence-parallel shards pass shard_index × shard_len).
 
-    Block sizes bound the kernel's VMEM working set: all three kernels
-    stream K/V (or Q/dO) tiles through a pipelined 3-D grid, so the
-    footprint is one tile per operand plus the [block_q, block_k]
-    intermediates — independent of S (the round-2 whole-sequence layout
-    hit the 16 MiB scoped-VMEM wall at block_k ≥ 1024; this one compiles
-    to (1024, 2048) and beyond).  See docs/benchmarks.md for the measured
-    block sweep; defaults are the sweep optimum.
+    Tiling: the grid streams (block_k, D) K/V super tiles (Q/dO super
+    tiles of block_q rows in the dk/dv pass) double-buffered — few, large
+    DMAs and few grid steps — while the in-kernel loop computes over
+    ``sub``-sized slices so the [block_q, sub] intermediates bound scoped
+    VMEM independent of S (the round-2 whole-sequence layout hit the
+    16 MiB wall at block_k >= 1024).  See docs/benchmarks.md for the
+    measured sweep; defaults are the sweep optimum at long S and clamp
+    themselves to short sequences.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     block_q = min(block_q, max(q.shape[1], 1))
     block_k = min(block_k, max(k.shape[1], 1))
     return _flash(q, k, v, causal, q_offset, k_offset, block_q, block_k,
-                  interpret)
+                  sub, interpret)
 
 
 def flash_attention_with_lse(q, k, v, causal: bool = True, q_offset=0,
-                             k_offset=0, block_q: int = 128,
-                             block_k: int = 128,
+                             k_offset=0, block_q: int = 1024,
+                             block_k: int = 1024, sub: int = 1024,
                              interpret: bool | None = None):
     """Forward-only fused attention returning (out, lse).
 
@@ -530,12 +626,13 @@ def flash_attention_with_lse(q, k, v, causal: bool = True, q_offset=0,
     block_q = min(block_q, max(q.shape[1], 1))
     block_k = min(block_k, max(k.shape[1], 1))
     return _flash_forward(q, k, v, causal, q_offset, k_offset, block_q,
-                          block_k, interpret, with_lse=True)
+                          block_k, interpret, sub=sub, with_lse=True)
 
 
-def make_flash_attention(block_q: int = 128, block_k: int = 128):
+def make_flash_attention(block_q: int = 1024, block_k: int = 1024,
+                         sub: int = 1024):
     """Adapter producing a ``TransformerConfig.attention_fn``."""
     def attn(q, k, v, causal=True):
         return flash_attention(q, k, v, causal=causal, block_q=block_q,
-                               block_k=block_k)
+                               block_k=block_k, sub=sub)
     return attn

@@ -141,7 +141,7 @@ def _ring_flash_forward(q, k, v, axis_name, causal, block_q, block_k):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def ring_flash_attention(q, k, v, axis_name: str, causal: bool = True,
-                         block_q: int = 128, block_k: int = 128):
+                         block_q: int = 512, block_k: int = 1024):
     """Ring attention whose per-step block attention is the fused Pallas
     flash kernel (ops/flash_attention.py), merged across steps with exact
     log-sum-exp combining.
@@ -208,8 +208,8 @@ def _ring_flash_bwd(axis_name, causal, block_q, block_k, res, g):
 ring_flash_attention.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 
 
-def make_ring_flash_attention(axis_name: str, block_q: int = 128,
-                              block_k: int = 128):
+def make_ring_flash_attention(axis_name: str, block_q: int = 512,
+                              block_k: int = 1024):
     """Adapter producing a ``TransformerConfig.attention_fn``."""
     return functools.partial(ring_flash_attention, axis_name=axis_name,
                              block_q=block_q, block_k=block_k)
@@ -314,7 +314,7 @@ def _zigzag_flash_forward(q, k, v, axis_name, causal, block_q, block_k):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def zigzag_ring_flash_attention(q, k, v, axis_name: str, causal: bool = True,
-                                block_q: int = 128, block_k: int = 128):
+                                block_q: int = 512, block_k: int = 1024):
     """Load-balanced causal ring attention over zigzag-sharded sequences.
 
     Inputs are this rank's zigzag shard ([B, 2c, H, D], chunks (r, 2n−1−r)
@@ -391,8 +391,8 @@ def _zigzag_bwd(axis_name, causal, block_q, block_k, res, g):
 zigzag_ring_flash_attention.defvjp(_zigzag_fwd, _zigzag_bwd)
 
 
-def make_zigzag_ring_flash_attention(axis_name: str, block_q: int = 128,
-                                     block_k: int = 128):
+def make_zigzag_ring_flash_attention(axis_name: str, block_q: int = 512,
+                                     block_k: int = 1024):
     """Adapter producing a ``TransformerConfig.attention_fn`` (pair with
     ``positions=zigzag_positions(...)`` so RoPE matches the layout)."""
     return functools.partial(zigzag_ring_flash_attention,

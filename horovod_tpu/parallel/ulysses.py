@@ -49,8 +49,8 @@ def make_ulysses_attention(axis_name: str, inner=dense_causal_attention):
                              inner=inner)
 
 
-def make_ulysses_flash_attention(axis_name: str, block_q: int = 128,
-                                 block_k: int = 128):
+def make_ulysses_flash_attention(axis_name: str, block_q: int = 1024,
+                                 block_k: int = 1024, sub: int = 1024):
     """Ulysses with the fused flash kernel as the local attention: after
     the head exchange each chip holds the FULL sequence for H/n heads, so
     the O(S·D)-memory kernel (fwd + fused bwd, causal-bounded) applies
@@ -58,4 +58,4 @@ def make_ulysses_flash_attention(axis_name: str, block_q: int = 128,
     from horovod_tpu.ops.flash_attention import make_flash_attention
 
     return make_ulysses_attention(
-        axis_name, inner=make_flash_attention(block_q, block_k))
+        axis_name, inner=make_flash_attention(block_q, block_k, sub=sub))
