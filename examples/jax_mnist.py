@@ -97,8 +97,8 @@ def main():
     # host-to-device transfer overlaps the current step (the overlap the
     # reference got from DataLoader workers + CUDA streams).  On a real
     # TPU run pass sharding=(hvd.data_sharding(4), hvd.data_sharding(1))
-    # to land batches pre-sharded; see prefetch_to_device's note on why
-    # the CPU simulation backend must not.
+    # to land batches pre-sharded (safe everywhere: on the CPU simulation
+    # backend sharded puts complete synchronously — prefetch_to_device).
     for epoch in range(args.epochs):
         t0 = time.time()
         loss = None

@@ -159,18 +159,21 @@ def prefetch_to_device(iterator: Iterable, size: int = 2,
     shardings matching the batch structure.  Without it, leaves land on
     the default device and the jitted step's in_specs perform the split.
 
-    .. warning:: pass ``sharding`` on real TPU runs only.  On the CPU
-       *simulation* backend (``--xla_force_host_platform_device_count``),
-       multi-device transfer programs interleaved with a compiled step's
-       collectives can starve XLA's in-process collective rendezvous
-       past its hard abort (rendezvous.cc termination timeout) — observed
-       as "Expected N threads to join the rendezvous, but only N-1
-       arrived".  The default (single-device put, resharded by the step)
-       is stable everywhere.
+    On the CPU *simulation* backend
+    (``--xla_force_host_platform_device_count``), sharded puts complete
+    SYNCHRONOUSLY before yielding: async multi-device transfer programs
+    interleaved with a compiled step's collectives can starve XLA's
+    in-process collective rendezvous past its hard abort (rendezvous.cc
+    termination timeout, "Expected N threads to join the rendezvous,
+    but only N-1 arrived").  Overlap is a no-op on a simulated backend,
+    so nothing is lost — and ``sharding=`` is safe everywhere.
     """
     import jax
 
     put = device_put or jax.device_put
+    # CPU sim: see the note above — complete each sharded transfer before
+    # any step may run its collectives.
+    sync = sharding is not None and jax.default_backend() == "cpu"
     buf: list = []
     it = iter(iterator)
 
@@ -180,8 +183,11 @@ def prefetch_to_device(iterator: Iterable, size: int = 2,
                 batch = next(it)
             except StopIteration:
                 return
-            buf.append(put(batch, sharding) if sharding is not None
-                       else put(batch))
+            out = (put(batch, sharding) if sharding is not None
+                   else put(batch))
+            if sync:
+                jax.block_until_ready(out)
+            buf.append(out)
 
     enqueue(size)
     while buf:

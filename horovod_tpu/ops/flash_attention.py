@@ -40,6 +40,23 @@ except ImportError:  # pragma: no cover
 NEG_INF = -1e30
 
 
+def _sub_bounds(k_len, q_min, q_max, ks_min, sub_k, nsub, causal):
+    """Sub-tile split bounds shared by the forward and dq kernels: ``hi``
+    is the causal sweep end (tiles past the diagonal contribute p == 0),
+    ``interior_end`` the mask-free prefix (entirely below the diagonal and
+    inside the valid K range)."""
+    if causal:
+        hi = jnp.clip((q_max - ks_min) // sub_k + 1, 0, nsub)
+    else:
+        hi = nsub
+    valid_end = (k_len - ks_min) // sub_k
+    if causal:
+        interior_end = jnp.minimum((q_min - ks_min + 1) // sub_k, valid_end)
+    else:
+        interior_end = valid_end
+    return hi, jnp.clip(interior_end, 0, hi)
+
+
 def _flash_kernel(meta_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref,
                   l_ref, *, block_q: int, block_k: int, sub_k: int,
                   num_k_blocks: int, causal: bool, scale: float):
@@ -77,16 +94,8 @@ def _flash_kernel(meta_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref,
     q_max = q_min + block_q - 1
     ks_min = meta_ref[1] + ki * block_k   # super-tile base position
     # Sub-tile bounds (scalar arithmetic on SMEM values):
-    if causal:
-        hi = jnp.clip((q_max - ks_min) // sub_k + 1, 0, nsub)
-    else:
-        hi = nsub
-    valid_end = (meta_ref[2] - ks_min) // sub_k
-    if causal:
-        interior_end = jnp.minimum((q_min - ks_min + 1) // sub_k, valid_end)
-    else:
-        interior_end = valid_end
-    interior_end = jnp.clip(interior_end, 0, hi)
+    hi, interior_end = _sub_bounds(meta_ref[2], q_min, q_max, ks_min,
+                                   sub_k, nsub, causal)
 
     q = q_ref[0].astype(jnp.float32) * scale              # [bq, D]
 
@@ -280,16 +289,8 @@ def _bwd_dq_kernel(meta_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     q_min = meta_ref[0] + qi * block_q
     q_max = q_min + block_q - 1
     ks_min = meta_ref[1] + ki * block_k
-    if causal:
-        hi = jnp.clip((q_max - ks_min) // sub_k + 1, 0, nsub)
-    else:
-        hi = nsub
-    valid_end = (meta_ref[2] - ks_min) // sub_k
-    if causal:
-        interior_end = jnp.minimum((q_min - ks_min + 1) // sub_k, valid_end)
-    else:
-        interior_end = valid_end
-    interior_end = jnp.clip(interior_end, 0, hi)
+    hi, interior_end = _sub_bounds(meta_ref[2], q_min, q_max, ks_min,
+                                   sub_k, nsub, causal)
 
     q = q_ref[0].astype(jnp.float32) * scale              # [bq, D]
     do = do_ref[0].astype(jnp.float32)                    # [bq, D]
@@ -456,6 +457,12 @@ def flash_attention_backward(q, k, v, dout, lse, delta, causal,
     b, s_q, h, d = q.shape
     s_k = k.shape[1]
     scale = d ** -0.5
+    # Clamp to the actual sequence lengths (like the public forward
+    # wrappers): ring/zigzag drive this entry per ring step with SHARD
+    # lengths — without the clamp the 512/1024 defaults would pad small
+    # shards up to the block size and double the backward work.
+    block_q = min(block_q, max(s_q, 1))
+    block_k = min(block_k, max(s_k, 1))
     block_q, sub_q = _sub_fit(block_q, sub)
     block_k, sub_k = _sub_fit(block_k, sub)
     # The dk/dv pass's k tile is BOTH its resident accumulator width and

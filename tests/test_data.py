@@ -130,3 +130,30 @@ def test_prefetch_issues_puts_ahead(hvd):
     first = next(it)
     assert first == 0
     assert len(puts) >= 3  # batch 1 and 2 already transferred
+
+
+def test_prefetch_sharded_with_collective_step(hvd):
+    """Sharded prefetch feeding a compiled step WITH collectives on the CPU
+    sim — the interleave that used to starve the in-process collective
+    rendezvous (now safe: sharded puts complete synchronously there)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    import horovod_tpu as hvd_pkg
+
+    n = hvd_pkg.num_chips()
+    batches = [np.full((n, 4), float(i), np.float32) for i in range(4)]
+
+    @jax.jit
+    @hvd_pkg.shard(in_specs=hvd_pkg.batch_spec(2), out_specs=P())
+    def step(x):
+        return jax.lax.psum(x.sum(), "hvd")
+
+    # Dispatch steps WITHOUT fetching results (a realistic consumer keeps
+    # the loss as an unfetched device array), so sharded transfers for
+    # batch N+1 are issued while batch N's collectives may still be in
+    # flight — the interleave that starved the rendezvous.
+    outs = [step(xb) for xb in prefetch_to_device(
+        batches, size=2, sharding=hvd_pkg.data_sharding(2))]
+    total = sum(float(o) for o in outs)
+    assert total == sum(float(b.sum()) for b in batches)
