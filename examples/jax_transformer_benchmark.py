@@ -55,6 +55,9 @@ def main():
                     help="k-side super tile (streamed in fwd/dq passes)")
     ap.add_argument("--sub", type=int, default=1024,
                     help="in-kernel compute sub-tile")
+    ap.add_argument("--remat", action="store_true",
+                    help="rematerialize each block in backward "
+                         "(jax.checkpoint) — required for very long S")
     ap.add_argument("--peak-tflops", type=float, default=197.0,
                     help="bf16 peak of the chip (v5e default)")
     ap.add_argument("--steps-per-call", type=int, default=4,
@@ -68,6 +71,7 @@ def main():
                num_heads=args.heads, head_dim=args.embed // args.heads,
                embed_dim=args.embed, mlp_dim=4 * args.embed,
                max_seq_len=args.seq_len, dtype=jnp.bfloat16,
+               remat=args.remat,
                # bf16 logits buffer (f32 softmax via the fused upcast below)
                logits_dtype=jnp.bfloat16)
     attn = None if args.no_flash else make_flash_attention(
@@ -151,6 +155,7 @@ def main():
             "block_q": args.block_q,
             "block_k": args.block_k,
             "sub": args.sub,
+            "remat": args.remat,
         }))
 
 

@@ -48,6 +48,11 @@ class TransformerConfig:
     # traffic at large vocab; bfloat16 halves it — upcast inside your loss
     # (the cast fuses into the softmax chain, nothing f32 is materialized).
     logits_dtype: Any = jnp.float32
+    # Rematerialize each block in the backward pass (jax.checkpoint):
+    # activation memory drops from O(L) layer working sets to one layer +
+    # L boundary tensors — the FLOPs-for-HBM trade long-context training
+    # needs (S=32K training OOMs 15.75G HBM without it; fits with it).
+    remat: bool = False
 
 
 def rope(x, positions, theta: float):
@@ -149,8 +154,9 @@ class Transformer(nn.Module):
         elif positions.ndim == 1:
             positions = positions[None, :]
         positions = jnp.broadcast_to(positions, tokens.shape)
+        block_cls = nn.remat(Block) if cfg.remat else Block
         for i in range(cfg.num_layers):
-            x = Block(cfg, name=f"layer_{i}")(x, positions)
+            x = block_cls(cfg, name=f"layer_{i}")(x, positions)
         x = nn.RMSNorm(dtype=cfg.dtype, name="final_norm")(x)
         # Head matmul in the compute dtype (bf16 hits the MXU at full rate;
         # f32 params, XLA accumulates in f32); logits upcast for the loss —
