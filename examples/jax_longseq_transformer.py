@@ -39,6 +39,10 @@ def main():
     ap.add_argument("--zigzag", action="store_true",
                     help="zigzag sequence layout: balances causal work "
                          "across the ring (implies --flash)")
+    ap.add_argument("--remat", action="store_true",
+                    help="rematerialize each block in backward "
+                         "(jax.checkpoint) — pairs with sequence "
+                         "parallelism for very long S")
     args = ap.parse_args()
 
     hvd.init()
@@ -49,7 +53,7 @@ def main():
     base = dict(vocab_size=32000, num_layers=args.layers,
                 num_heads=args.heads, head_dim=args.embed // args.heads,
                 embed_dim=args.embed, mlp_dim=4 * args.embed,
-                max_seq_len=args.seq_len)
+                max_seq_len=args.seq_len, remat=args.remat)
     if args.zigzag:
         from horovod_tpu.parallel import make_zigzag_ring_flash_attention
 

@@ -159,3 +159,12 @@ def test_tensorflow_mnist_np2():
     assert "[0]: " in out and "[1]: " in out
     vals = _final_metrics(out)
     assert vals[0] == vals[1], vals
+
+
+def test_jax_longseq_transformer_zigzag_remat():
+    """Remat composes with zigzag ring attention: jax.checkpoint wraps a
+    block whose attention does ppermute collectives inside shard_map."""
+    out = _run("jax_longseq_transformer.py", "--seq-len", "512", "--layers",
+               "1", "--heads", "4", "--embed", "64", "--steps", "1",
+               "--zigzag", "--remat")
+    assert "step 0" in out
