@@ -92,7 +92,7 @@ def _detect_slices(devices) -> tuple[int, int]:
 def init(*, distributed: bool | None = None, coordinator_address: str | None = None,
          num_processes: int | None = None, process_id: int | None = None,
          mesh_axes: dict[str, int] | None = None,
-         ranks: list[int] | None = None) -> None:
+         ranks: list[int] | None = None, comm=None) -> None:
     """Initialize horovod_tpu — the analog of ``hvd.init()``.
 
     Unlike the reference (which boots MPI, reference operations.cc:1435-1663),
@@ -117,11 +117,33 @@ def init(*, distributed: bool | None = None, coordinator_address: str | None = N
     Collectives that still require the full jax job under a subset (the
     legacy ``HVD_TPU_EAGER_REDUCE=gather`` transport) raise clearly.
 
+    ``comm`` is the reference's parameter spelling (``hvd.init(comm=[0, 2])``,
+    common/__init__.py:58-67): a list is treated exactly like ``ranks``;
+    an mpi4py communicator has no TPU analog and raises with direction.
+
     Safe to call more than once (subsequent calls are no-ops), matching
     ``InitializeHorovodOnce`` (reference operations.cc:1907-1925).
     """
     global _topology
     import jax
+
+    if comm is not None:
+        if ranks is not None:
+            raise ValueError("pass either ranks= or comm=, not both")
+        if hasattr(comm, "Get_rank"):  # duck-typed mpi4py communicator
+            raise NotImplementedError(
+                "init(comm=<mpi4py communicator>) has no TPU analog (there "
+                "is no MPI underneath); pass the member process indices as "
+                "a list instead — init(comm=[0, 2]) or init(ranks=[0, 2])")
+        try:
+            comm = [int(r) for r in comm]
+        except TypeError:
+            raise TypeError(
+                f"init(comm=...) takes a list of process indices (reference "
+                f"common/__init__.py:58-67), got {type(comm).__name__}")
+        # Reference parity: an empty list means the full job (COMM_WORLD,
+        # reference common/__init__.py:65-66).
+        ranks = comm or None
 
     with _lock:
         if _topology is not None:

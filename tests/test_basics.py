@@ -43,3 +43,42 @@ def test_mesh(hvd):
     m = hvd.global_mesh()
     assert m.devices.size == 8
     assert "hvd" in m.axis_names or "ici" in m.axis_names
+
+
+def test_init_comm_alias(hvd, monkeypatch):
+    """Reference spelling hvd.init(comm=...) (common/__init__.py:58-67):
+    a list aliases ranks= on a FRESH init; [] means the full job; an
+    mpi4py-style communicator raises with direction."""
+    import jax as _jax
+    import pytest as _pytest
+
+    from horovod_tpu import basics
+
+    class FakeComm:  # duck-types an mpi4py communicator
+        def Get_rank(self):
+            return 0
+
+    with _pytest.raises(NotImplementedError, match="mpi4py"):
+        basics.init(comm=FakeComm())
+    with _pytest.raises(TypeError, match="int"):
+        basics.init(comm=7)
+
+    # Fresh init with a subset comm on a simulated 4-process job: the
+    # alias must actually restrict the topology (rank = position in the
+    # list), not silently initialize the full world.
+    basics.shutdown()
+    try:
+        monkeypatch.setattr(_jax, "process_count", lambda: 4)
+        monkeypatch.setattr(_jax, "process_index", lambda: 2)
+        basics.init(comm=[0, 2])
+        assert basics.size() == 2 and basics.rank() == 1
+        assert basics.member_process_ids() == (0, 2)
+        assert basics.subset_active()
+        basics.shutdown()
+        # Reference parity: comm=[] is COMM_WORLD (the full job).
+        basics.init(comm=[])
+        assert basics.size() == 4 and not basics.subset_active()
+    finally:
+        basics.shutdown()
+        monkeypatch.undo()
+        basics.init()   # restore for subsequent tests (hvd fixture no-ops)
