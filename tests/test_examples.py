@@ -4,6 +4,7 @@ on the virtual 8-chip mesh, plus launcher-driven ``-np 2`` runs of the
 flagship examples (the reference's primary test mode, ``mpirun -np 2``)
 asserting rank-tagged output and identical final metrics on every rank."""
 
+import json
 import os
 import re
 import subprocess
@@ -168,3 +169,18 @@ def test_jax_longseq_transformer_zigzag_remat():
                "1", "--heads", "4", "--embed", "64", "--steps", "1",
                "--zigzag", "--remat")
     assert "step 0" in out
+
+
+def test_weak_scaling_benchmark_np2():
+    """The weak-scaling harness (scaling-efficiency ingredient (b),
+    docs/benchmarks.md) runs under the launcher and reports per-rank rate
+    plus the ~2V wire model."""
+    out = _run_np2("weak_scaling_benchmark.py", "--grad-mb", "1",
+                   "--compute-reps", "1", "--steps", "3", "--warmup", "1")
+    rows = [json.loads(line.split("]: ", 1)[1])
+            for line in out.splitlines() if '"steps_per_s_per_rank"' in line]
+    assert {r["rank"] for r in rows} == {0, 1}
+    for r in rows:
+        assert r["workers"] == 2
+        assert r["wire_model_mb_per_rank_per_step"] == 1.0
+        assert r["steps_per_s_per_rank"] > 0
