@@ -565,3 +565,49 @@ SUBSET_WORKER = textwrap.dedent("""
 
 def test_rank_subset_job():
     _run_workers(SUBSET_WORKER, 3)
+
+
+# Sparse (COO gather-path) allreduce with int8 compression across
+# processes: each rank ships (one f32 scale, int8 values) and the receiver
+# dequantizes each rank's SEGMENT by its own scale — single-process runs
+# collapse to one segment, so only this shape exercises the bookkeeping.
+SPARSE_WORKER = PRELUDE + textwrap.dedent("""
+    import torch
+    import horovod_tpu.torch as hvdt
+
+    # Rank r contributes rows {r, 2} with magnitude scaled by 1000**r —
+    # WILDLY different per-rank scales; a shared grid (scale ~ 2000/127)
+    # would quantize rank 0's 0.5 to round(0.03) = 0.
+    mag = 1000.0 ** rank
+    dense = torch.zeros(6, 3)
+    dense[rank] = 0.5 * mag
+    dense[2] += torch.arange(3, dtype=torch.float32) * mag
+    sp = dense.to_sparse_coo()
+    out = hvdt.allreduce(sp, average=False,
+                         compression=hvdt.Compression.int8)
+    expect = torch.zeros(6, 3)
+    for r in range(n):
+        expect[r] += 0.5 * (1000.0 ** r)
+        expect[2] += torch.arange(3, dtype=torch.float32) * (1000.0 ** r)
+    got = out.to_dense()
+    # Per-segment error <= that rank's scale/2 = amax_r/254; values at an
+    # exact half-step (1000 on a 2000/127 grid) sit ON the bound, so give
+    # it 0.1% slack for float arithmetic.
+    tol = sum((1000.0 ** r) * 2 / 254 for r in range(n)) * 1.001 + 1e-6
+    assert torch.all((got - expect).abs() <= tol), (got, expect)
+    assert got[0].abs().sum() > 0, "small-scale rank zeroed by shared grid"
+
+    # fp16 cast wire on the same path
+    out16 = hvdt.allreduce(sp, average=True,
+                           compression=hvdt.Compression.fp16)
+    # atol small enough that a dropped/zeroed rank-0 segment (0.25) fails;
+    # rtol absorbs fp16 representation error on the large segments.
+    torch.testing.assert_close(out16.to_dense(), expect / n,
+                               atol=0.02, rtol=0.01)
+    hvd.barrier(name="sparse.done")
+    print(f"RANK{rank} OK", flush=True)
+""")
+
+
+def test_sparse_compression_across_processes():
+    _run_workers(SPARSE_WORKER, 2)
