@@ -1,0 +1,108 @@
+"""FSDP / ZeRO-3 transformer training — parameters, gradients, and
+optimizer state sharded over the data axis by sharding annotations alone.
+
+The train step is ordinary single-program code (loss → grad → adam); the
+``fsdp_shardings`` in/out annotations make XLA materialize each layer's
+parameters just-in-time with all-gathers (overlapped with compute) and land
+gradients pre-sharded with reduce-scatters — ZeRO-3 without wrapper
+modules or hooks (parallel/fsdp.py; HLO dataflow pinned in
+tests/test_fsdp.py).  Beyond reference scope: upstream replicates
+parameters on every rank and broadcasts at init
+(reference horovod/torch/__init__.py:185-301).
+
+Prints the measured per-device parameter+state bytes vs the replicated
+footprint — the K-fold memory win is the point of FSDP.
+
+Run:  python examples/jax_fsdp_transformer.py [--steps 20]
+(on CPU: XLA_FLAGS=--xla_force_host_platform_device_count=8)
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.models import Transformer, TransformerConfig
+from horovod_tpu.parallel import fsdp_device_put, fsdp_shardings
+
+
+def _local_bytes(tree) -> int:
+    return sum(l.addressable_shards[0].data.nbytes
+               for l in jax.tree.leaves(tree)
+               if hasattr(l, "addressable_shards"))
+
+
+def _global_bytes(tree) -> int:
+    return sum(l.nbytes for l in jax.tree.leaves(tree))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--seq-len", type=int, default=16)
+    ap.add_argument("--vocab", type=int, default=128)
+    ap.add_argument("--layers", type=int, default=2)
+    args = ap.parse_args()
+
+    hvd.init()
+    n = hvd.num_chips()
+
+    cfg = TransformerConfig(vocab_size=args.vocab, num_layers=args.layers,
+                            num_heads=4, head_dim=8, embed_dim=32,
+                            mlp_dim=64, dtype=jnp.float32)
+    model = Transformer(cfg)
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, args.vocab,
+                                     (args.batch, args.seq_len)))
+    params = model.init(jax.random.PRNGKey(0), tokens)
+    tx = optax.adam(1e-3)
+    opt_state = tx.init(params)
+
+    # The FSDP move: one NamedSharding per leaf (largest divisible dim over
+    # the data axes), then jit with those shardings on both sides.
+    shardings = fsdp_shardings((params, opt_state), min_size=8)
+    state = fsdp_device_put((params, opt_state), shardings)
+
+    def train_step(state, tokens):
+        params, opt_state = state
+
+        def loss_fn(p):
+            logits = model.apply(p, tokens)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], tokens[:, 1:]).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return (optax.apply_updates(params, updates), opt_state), loss
+
+    step = jax.jit(train_step,
+                   in_shardings=(shardings, hvd.data_sharding(tokens.ndim)),
+                   out_shardings=(shardings, None),
+                   donate_argnums=0)
+
+    losses = []
+    for _ in range(args.steps):
+        state, loss = step(state, tokens)
+        losses.append(float(loss))
+
+    if hvd.rank() == 0:
+        local = _local_bytes(state)
+        total = _global_bytes(state)
+        for i in range(0, args.steps, 5):
+            print(f"step {i}: loss={losses[i]:.4f}", flush=True)
+        print(f"fsdp training ({n} devices): first={losses[0]:.4f} "
+              f"last={losses[-1]:.4f} improved={bool(losses[-1] < losses[0])}",
+              flush=True)
+        print(f"fsdp memory: {local} bytes/device of params+opt state "
+              f"vs {total} replicated "
+              f"({total / max(local, 1):.1f}x shrink)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

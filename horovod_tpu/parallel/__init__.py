@@ -10,7 +10,9 @@ shard_map/collective programs over the global mesh:
 * tensor parallelism — Megatron column/row layers (tensor_parallel.py);
 * pipeline parallelism — SPMD GPipe, scan-of-ppermute (pipeline.py);
 * expert parallelism — switch-MoE over alltoall (expert.py);
-* optimizer-state sharding — ZeRO-1 reduce-scatter/all-gather (zero.py).
+* optimizer-state sharding — ZeRO-1 reduce-scatter/all-gather (zero.py);
+* full parameter sharding — FSDP / ZeRO-3 via sharding annotations
+  (fsdp.py): XLA inserts the just-in-time gathers and grad scatters.
 
 See docs/parallelism.md for the usage guide.
 """
@@ -48,3 +50,8 @@ from horovod_tpu.parallel.expert import (  # noqa: F401
     switch_route,
 )
 from horovod_tpu.parallel.zero import zero_optimizer  # noqa: F401
+from horovod_tpu.parallel.fsdp import (  # noqa: F401
+    fsdp_device_put,
+    fsdp_shardings,
+    fsdp_spec,
+)

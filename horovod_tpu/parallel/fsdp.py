@@ -1,0 +1,111 @@
+"""FSDP / ZeRO-3 — parameters, gradients, AND optimizer state sharded over
+the data axis, expressed as sharding annotations.
+
+ZeRO-1 (zero.py) shards only optimizer state, inside an explicit shard_map.
+FSDP goes all the way: parameter leaves themselves live sharded across the
+data-parallel devices, and every step XLA inserts just-in-time all-gathers
+(one layer's parameters at a time, overlapped with compute), computes with
+the batch-sharded data, and lands gradients back on the shards for the
+sharded optimizer update (a reduce-scatter on TPU; some backends' SPMD
+partitioners lower the same contract as all-reduce + slice).  Per-device
+memory for params + grads + optimizer state shrinks K-fold; wire bytes per
+step are ~1.5× the ring allreduce they replace (gather V·(K-1)/K forward,
+gather again backward, scatter V·(K-1)/K for grads — the ZeRO-3 trade
+stated in the paper).
+
+This is the TPU-native formulation (GSPMD): no wrapper module, no hooks, no
+manual prefetch ordering — the reference's world (SURVEY §2.9) replicates
+parameters on every rank and broadcasts at init
+(/root/reference/horovod/torch/__init__.py:185-301 broadcasts the full
+replicated state), so all of ZeRO is beyond-reference scope.  Usage:
+
+    shardings = fsdp_shardings((params, opt_state))      # pick specs
+    params, opt_state = fsdp_device_put((params, opt_state), shardings)
+    step = jax.jit(train_step,
+                   in_shardings=(shardings, hvd.data_sharding(batch.ndim)),
+                   out_shardings=(shardings, None),
+                   donate_argnums=0)
+
+``train_step`` is ordinary single-program code (loss -> grad -> optax
+update); the annotations alone make it ZeRO-3.
+tests/test_fsdp.py::test_fsdp_emits_gather_scatter pins the compiled-HLO
+just-in-time AllGather dataflow, and test_fsdp_state_is_sharded pins the
+K-fold per-device state shrink the annotations guarantee.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from horovod_tpu import mesh as mesh_mod
+
+# Leaves smaller than this many elements stay replicated: gathering a bias
+# vector costs a collective launch per step and saves nothing material.
+DEFAULT_MIN_SIZE = 1024
+
+
+def fsdp_spec(shape, n_shards: int, axes,
+              min_size: int = DEFAULT_MIN_SIZE) -> PartitionSpec:
+    """PartitionSpec sharding ONE dimension of ``shape`` over ``axes``.
+
+    Picks the largest dimension divisible by ``n_shards`` (ties -> the
+    earliest, matching the row-major layouts flax emits); leaves with no
+    divisible dimension, scalars, and leaves below ``min_size`` elements
+    replicate.  ``axes`` may be one name or a tuple ((dcn, ici) meshes).
+    """
+    shape = tuple(shape)
+    size = int(np.prod(shape, dtype=np.int64)) if shape else 1
+    if n_shards <= 1 or size < max(min_size, 1):
+        return PartitionSpec()
+    best = None
+    for d, extent in enumerate(shape):
+        if extent % n_shards == 0 and (best is None or extent > shape[best]):
+            best = d
+    if best is None:
+        return PartitionSpec()
+    spec: list = [None] * (best + 1)
+    spec[best] = axes if isinstance(axes, str) or len(axes) > 1 else axes[0]
+    return PartitionSpec(*spec)
+
+
+def _resolve(mesh: Mesh | None, axes):
+    if mesh is None:
+        mesh = mesh_mod.global_mesh()
+        if axes is None:
+            axes = mesh_mod.data_axes()
+    if axes is None:
+        axes = (mesh.axis_names[0],)
+    if isinstance(axes, str):
+        axes = (axes,)
+    axes = tuple(axes)
+    n = 1
+    for a in axes:
+        n *= mesh.shape[a]
+    return mesh, axes, n
+
+
+def fsdp_shardings(tree, mesh: Mesh | None = None, axes=None,
+                   min_size: int = DEFAULT_MIN_SIZE):
+    """Map every array leaf of ``tree`` to its FSDP NamedSharding.
+
+    Works uniformly on params, gradients, optimizer state, or any pytree
+    bundling them (optax's mu/nu mirror the param shapes, so they land on
+    the same specs; scalar ``count`` leaves replicate).  ``axes`` defaults
+    to the global mesh's data axes — pass a subset to combine FSDP with
+    tensor/pipeline axes on the same mesh.
+    """
+    mesh, axes, n = _resolve(mesh, axes)
+
+    def leaf(v):
+        shape = getattr(v, "shape", ())
+        return NamedSharding(mesh, fsdp_spec(shape, n, axes, min_size))
+
+    return jax.tree.map(leaf, tree)
+
+
+def fsdp_device_put(tree, shardings):
+    """Place ``tree`` leaves onto their FSDP shards (host or full-replica
+    arrays in, K-way sharded jax.Arrays out)."""
+    return jax.tree.map(jax.device_put, tree, shardings)

@@ -60,7 +60,8 @@ _grad_scale.defvjp(_grad_scale_fwd, _grad_scale_bwd)
 
 def pipeline_apply(stage_fn: Callable, params, x,
                    num_microbatches: int | None = None,
-                   axis_name: str = PP_AXIS):
+                   axis_name: str = PP_AXIS,
+                   remat: bool = False):
     """Run ``stage_fn(params, mb)`` as a GPipe pipeline over ``axis_name``.
 
     Call inside shard_map with ``axis_name`` bound.  ``params`` are THIS
@@ -85,7 +86,16 @@ def pipeline_apply(stage_fn: Callable, params, x,
       ``lax.psum`` of their gradient over the axis;
     * a replicated consumer of the outputs (e.g. an lm head) already gets
       the true gradient on every device — no sync needed.
+
+    ``remat=True`` rematerializes each stage application in the backward
+    pass (``jax.checkpoint``): the scan then saves only the stage BOUNDARY
+    activations per tick instead of every intermediate inside ``stage_fn``
+    — the standard GPipe memory trade (recompute one stage's forward per
+    backward tick).  Use it when M microbatches of stage internals exceed
+    HBM; exact same gradients (pinned in tests/test_pipeline.py).
     """
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
     n_stages = lax.axis_size(axis_name)
     stage = lax.axis_index(axis_name)
     m = n_stages if num_microbatches is None else num_microbatches

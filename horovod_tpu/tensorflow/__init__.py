@@ -13,6 +13,7 @@ the numpy bridge exactly like the torch binding (mpi_ops.py here).
 from __future__ import annotations
 
 import itertools
+import math
 
 import numpy as np
 import tensorflow as tf
@@ -135,6 +136,45 @@ class BroadcastGlobalVariablesHook(tf.compat.v1.train.SessionRunHook):
         session.run(self.bcast_op)
 
 
+class _Int8ErrorFeedback:
+    """Per-gradient error feedback for the eager int8 wire.
+
+    Same engine-grid pre-quantization as torch/optimizer.py
+    ``_int8_with_ef``: add the carried residual, round onto the engine's
+    own quantization grid (scale = max(amax/127, tiny) — core/qwire.py),
+    keep the new residual host-side, and ship the dequantized values; the
+    engine re-derives the identical scale (max |q| = 127), so q·s
+    survives the wire bit-for-bit and the residual accounting holds.
+    Eager-only: inside ``tf.function`` the residual state cannot be
+    carried, so gradients ship EF-free for those steps (the engine's
+    quantization is still applied)."""
+
+    def __init__(self):
+        self._residuals: dict = {}
+
+    def ship(self, key, grad):
+        if (not tf.executing_eagerly()
+                or isinstance(grad, tf.IndexedSlices)
+                or not grad.dtype.is_floating):
+            return grad
+        g = tf.cast(grad, tf.float32)
+        e = self._residuals.get(key)
+        if e is not None:
+            g = g + e
+        n = g.shape.num_elements()
+        amax = float(tf.reduce_max(tf.abs(g))) if n else 0.0
+        if not math.isfinite(amax):
+            # Non-finite step: reset the residual (a carried NaN would
+            # poison error feedback long after a loss scaler recovers) and
+            # ship as-is so the wire's NaN propagation fires.
+            self._residuals[key] = tf.zeros_like(g)
+            return tf.cast(g, grad.dtype)
+        s = max(amax / 127.0, np.finfo(np.float32).tiny)
+        shipped = tf.clip_by_value(tf.round(g / s), -127.0, 127.0) * s
+        self._residuals[key] = g - shipped
+        return tf.cast(shipped, grad.dtype)
+
+
 def _allreduce_grad_value(grad, compression, sparse_as_dense,
                           device_dense='', device_sparse=''):
     """The per-gradient routing shared by every optimizer/tape wrapper:
@@ -153,10 +193,14 @@ class _DistributedOptimizerV1(tf.compat.v1.train.Optimizer):
     """TF-1 optimizer wrapper: override ``compute_gradients`` to allreduce
     (reference tensorflow/__init__.py:135-225).
 
-    ``Compression.int8`` here is EF-free: the per-step quantization
-    residual is dropped (best for short or quantization-robust runs).  The
-    torch and optax ``DistributedOptimizer`` wrappers carry error feedback;
-    use those when training length makes quantization bias a concern."""
+    ``Compression.int8`` here is EF-free: this wrapper builds a TF-1
+    graph, which cannot carry the host-side residual state (best for short
+    or quantization-robust runs).  Error feedback (``_Int8ErrorFeedback``)
+    engages only where gradients flow through EAGER Python: a custom loop
+    with ``DistributedGradientTape``, the keras ``DistributedOptimizer``
+    under ``run_eagerly=True`` (default ``model.fit`` compiles the train
+    step, where EF is inert), and always in the torch and optax wrappers.
+    Use those when training length makes quantization bias a concern."""
 
     def __init__(self, optimizer, name=None, use_locking=False,
                  device_dense='', device_sparse='',
@@ -210,6 +254,14 @@ def _create_distributed_keras_class(cls, name=None,
 
         def apply(self, grads, trainable_variables=None):
             if size() > 1:
+                if self._hvd_compression is Compression.int8:
+                    ef = getattr(self, "_hvd_ef", None)
+                    if ef is None:
+                        ef = self._hvd_ef = _Int8ErrorFeedback()
+                    # keras passes the full gradient list in a stable
+                    # variable order every step — index keys the residual.
+                    grads = [g if g is None else ef.ship(i, g)
+                             for i, g in enumerate(grads)]
                 grads = [
                     _allreduce_grad_value(g, self._hvd_compression,
                                           self._hvd_sparse_as_dense)
@@ -276,6 +328,8 @@ class _DistributedGradientTape:
         self._device_sparse = device_sparse
         self._compression = compression
         self._sparse_as_dense = sparse_as_dense
+        self._ef = (_Int8ErrorFeedback()
+                    if compression is Compression.int8 else None)
 
     def __getattr__(self, item):
         return getattr(self._tape, item)
@@ -291,6 +345,19 @@ class _DistributedGradientTape:
         grads = self._tape.gradient(target, sources, output_gradients)
         if size() <= 1:
             return grads
+        if self._ef is not None:
+            flat_g = tf.nest.flatten(grads)
+            flat_s = tf.nest.flatten(sources)
+            # Key residuals by variable identity when sources are
+            # variables (robust to call-order changes), else position.
+            # Position — NOT .ref() — for plain tensors: a watched tensor
+            # is typically a fresh object every step, so tensor-keyed
+            # residuals would never be reused and would accumulate.
+            keys = [s.ref() if isinstance(s, tf.Variable) else i
+                    for i, s in enumerate(flat_s)]
+            flat_g = [g if g is None else self._ef.ship(k, g)
+                      for k, g in zip(keys, flat_g)]
+            grads = tf.nest.pack_sequence_as(grads, flat_g)
         return tf.nest.map_structure(
             lambda g: _allreduce_grad_value(
                 g, self._compression, self._sparse_as_dense,

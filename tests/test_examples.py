@@ -128,6 +128,14 @@ def test_jax_pipeline_transformer():
     assert "improved=True" in out
 
 
+def test_jax_fsdp_transformer():
+    out = _run("jax_fsdp_transformer.py", "--steps", "12")
+    assert "improved=True" in out
+    # The K-fold memory shrink is the point of FSDP — assert it happened.
+    m = re.search(r"\((\d+\.\d)x shrink\)", out)
+    assert m and float(m.group(1)) > 2.0, out
+
+
 def test_torch_mnist_resume(tmp_path):
     ck = str(tmp_path / "tck")
     _run("torch_mnist.py", "--epochs", "1", "--ckpt-dir", ck)

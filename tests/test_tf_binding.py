@@ -53,6 +53,43 @@ def test_allreduce_int8_wire(hvdtf):
     np.testing.assert_allclose(out.numpy(), x.numpy())
 
 
+def test_int8_error_feedback_carrier(hvdtf):
+    """The eager int8 EF carrier quantizes on the engine grid, carries the
+    residual (cumulative shipped ≈ cumulative true within one grid step),
+    resets on non-finite, and passes through untouched in graph mode."""
+    from horovod_tpu.tensorflow import _Int8ErrorFeedback
+
+    ef = _Int8ErrorFeedback()
+    g = tf.constant([0.3, -0.7, 1.0])
+    s = 1.0 / 127  # engine scale for amax=1.0
+    shipped = ef.ship("k", g)
+    np.testing.assert_allclose(
+        shipped.numpy(),
+        np.clip(np.round(g.numpy() / s), -127, 127) * s, rtol=1e-6)
+    total = shipped.numpy().astype(np.float64)
+    for _ in range(50):
+        total += ef.ship("k", g).numpy()
+    # Error feedback: 51 identical steps drift by at most ~one grid step
+    # total, not 51 accumulated rounding errors.
+    np.testing.assert_allclose(total, 51 * g.numpy().astype(np.float64),
+                               atol=2 * s)
+
+    bad = tf.constant([np.nan, 1.0, 2.0])
+    out = ef.ship("k", bad)
+    assert np.isnan(out.numpy()).any()
+    assert not np.any(ef._residuals["k"].numpy())
+
+    ef2 = _Int8ErrorFeedback()
+
+    @tf.function
+    def graph_ship(x):
+        return ef2.ship("g", x)
+
+    x = tf.constant([0.3, 0.7])
+    np.testing.assert_array_equal(graph_ship(x).numpy(), x.numpy())
+    assert "g" not in ef2._residuals
+
+
 def test_allreduce_int_average_truncates(hvdtf):
     x = tf.constant([3, 5], tf.int32)
     out = hvdtf.allreduce(x, average=True)
