@@ -127,6 +127,58 @@ def test_fsdp_emits_gather_scatter(hvd):
         "gradients are neither reduce-scattered nor reduced"
 
 
+def test_fsdp_composes_with_accumulate_gradients(hvd):
+    """FSDP annotations + hvd.accumulate_gradients in one jitted step:
+    microbatched grads on sharded params must match the full-batch step."""
+    tx = optax.sgd(0.1)
+    params = _model_init()
+    opt = tx.init(params)
+    shardings = fsdp_shardings((params, opt), min_size=8)
+    batch_sh = (hvd.data_sharding(2), hvd.data_sharding(2))
+
+    def grad_fn(p, mbatch):
+        return jax.value_and_grad(_loss)(p, mbatch)
+
+    def step(state, batch, nmb):
+        p, o = state
+        _, grads = hvd.accumulate_gradients(grad_fn, p, batch, nmb)
+        updates, o = tx.update(grads, o, p)
+        return optax.apply_updates(p, updates), o
+
+    k = jax.random.PRNGKey(11)
+    batch = (jax.random.normal(k, (16, 32)),
+             jax.random.normal(jax.random.fold_in(k, 1), (16, 32)))
+
+    state = fsdp_device_put((params, opt), shardings)
+    acc = jax.jit(step, static_argnums=2,
+                  in_shardings=(shardings, batch_sh),
+                  out_shardings=shardings)(state, batch, 4)
+    full = jax.jit(step, static_argnums=2)((params, opt), batch, 1)
+    for key in params:
+        np.testing.assert_allclose(np.asarray(acc[0][key]),
+                                   np.asarray(full[0][key]),
+                                   atol=1e-5, rtol=1e-5)
+
+
+def test_fsdp_bf16_params(hvd):
+    """bf16 parameter leaves shard like f32 ones (dtype plays no role in
+    spec selection) and a step preserves leaf dtypes."""
+    tx = optax.sgd(0.1)
+    params = jax.tree.map(lambda p: p.astype(jnp.bfloat16), _model_init())
+    opt = tx.init(params)
+    shardings = fsdp_shardings((params, opt), min_size=8)
+    # w1 is (32, 64): the larger divisible dim (64) is the one sharded.
+    assert shardings[0]["w1"].spec == P(None, "hvd")
+    batch_sh = (hvd.data_sharding(2), hvd.data_sharding(2))
+    x = jnp.ones((16, 32), jnp.bfloat16)
+    state = fsdp_device_put((params, opt), shardings)
+    out = jax.jit(_train_step(tx), in_shardings=(shardings, batch_sh),
+                  out_shardings=(shardings, None))(state, (x, x))[0]
+    assert out[0]["w1"].dtype == jnp.bfloat16
+    assert out[0]["w1"].addressable_shards[0].data.size * 8 == \
+        out[0]["w1"].size
+
+
 def test_fsdp_hierarchical_axes(hvd):
     """(dcn, ici) mesh: one step of sharded training matches replicated."""
     devs = np.array(jax.devices()[:8]).reshape(2, 4)
