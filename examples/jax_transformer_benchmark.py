@@ -64,6 +64,10 @@ def main():
                     help="training steps per dispatched program (lax.scan "
                          "device loop — amortizes per-dispatch latency, "
                          "same as bench.py's BENCH_STEPS_PER_CALL)")
+    ap.add_argument("--profile", default=None, metavar="DIR",
+                    help="capture an XLA profiler trace of one timed "
+                         "dispatch into DIR (view in XProf/TensorBoard; "
+                         "rank 0 only — horovod_tpu.profiling.trace)")
     args = ap.parse_args()
 
     hvd.init()
@@ -125,6 +129,13 @@ def main():
         params, opt_state, loss = train_step(params, opt_state, tokens)
     if loss is not None:
         float(loss)  # hard sync (tunneled backends return early otherwise)
+
+    if args.profile:
+        from horovod_tpu import profiling
+
+        with profiling.trace(args.profile):
+            params, opt_state, loss = train_step(params, opt_state, tokens)
+            float(loss)
 
     rates = []
     for _ in range(args.num_iters):
