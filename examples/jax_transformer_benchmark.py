@@ -107,10 +107,13 @@ def main():
 
             def loss_fn(p):
                 logits = model.apply(p, tokens)
-                # f32 softmax numerics; the cast fuses into the CE chain so
-                # only the bf16 logits buffer ever reaches HBM.
-                return optax.softmax_cross_entropy_with_integer_labels(
-                    logits[:, :-1].astype(jnp.float32), tokens[:, 1:]).mean()
+                # f32 softmax numerics with a logits-dtype cotangent
+                # (ops/losses.py).  Measured perf-neutral at this size —
+                # the CE chain overlaps with async DMA (profile notes in
+                # docs/benchmarks.md) — kept for the numerics-safe bf16
+                # cotangent contract.
+                return hvd.softmax_cross_entropy(
+                    logits[:, :-1], tokens[:, 1:]).mean()
 
             loss, grads = jax.value_and_grad(loss_fn)(params)
             updates, opt_state = opt.update(grads, opt_state, params)

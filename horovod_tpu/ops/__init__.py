@@ -15,6 +15,7 @@ from horovod_tpu.ops.flash_attention import (  # noqa: F401
     flash_attention,
     make_flash_attention,
 )
+from horovod_tpu.ops.losses import softmax_cross_entropy  # noqa: F401
 from horovod_tpu.ops.async_ops import (  # noqa: F401
     allgather_async,
     allreduce_async,
