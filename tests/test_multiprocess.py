@@ -324,7 +324,8 @@ def _run_workers_once(script, nprocs, timeout, extra_env):
     outs = []
     for p in procs:
         try:
-            outs.append(p.communicate(timeout=timeout))
+            out, err = p.communicate(timeout=timeout)
+            outs.append((out, err, p.returncode))
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
@@ -334,20 +335,30 @@ def _run_workers_once(script, nprocs, timeout, extra_env):
 
 def _run_workers(script, nprocs, timeout=scaled(240), extra_env=None):
     outs = _run_workers_once(script, nprocs, timeout, extra_env)
-    if not all(f"RANK{r} OK" in out for r, (out, _) in enumerate(outs)):
+    if not all(f"RANK{r} OK" in out for r, (out, _, _) in enumerate(outs)):
         # Retry ONCE only on infrastructure noise (gloo/coordination
-        # rendezvous timing under load), never on assertion failures —
-        # those must surface even when a peer's death also produced a
-        # rendezvous timeout on another rank.
+        # rendezvous timing under load), never on real failures.  "Real"
+        # = any assertion, any signal-killed worker (segfault/abort in
+        # native code: negative returncode), or the engine's own
+        # synchronize() deadlock timeout — the peer ranks of such a death
+        # always print rendezvous noise too, and that noise must not
+        # launder the crash into a silent rerun.
+        # Substring signatures (not regexes): jax/gloo coordination noise,
+        # the engine's bounded TCP rendezvous, the CPU backend's collective
+        # termination abort, and socket-level churn under CI load.
         infra = ("Gloo", "DEADLINE_EXCEEDED", "coordination_service",
-                 "Address already in use")
-        real_failure = any("AssertionError" in err for _, err in outs)
+                 "Address already in use", "rendezvous timed out",
+                 "UNAVAILABLE", "Connection refused", "Termination timeout")
+        real_failure = any(
+            "AssertionError" in err or "did not complete within" in err
+            or rc < 0
+            for _, err, rc in outs)
         if not real_failure and any(
-                any(sig in err for sig in infra) for _, err in outs):
+                any(sig in err for sig in infra) for _, err, rc in outs):
             outs = _run_workers_once(script, nprocs, timeout, extra_env)
-    for r, (out, err) in enumerate(outs):
+    for r, (out, err, _) in enumerate(outs):
         assert f"RANK{r} OK" in out, f"rank {r} failed:\n{err[-3000:]}"
-    return outs
+    return [(out, err) for out, err, _ in outs]
 
 
 @pytest.mark.parametrize("nprocs", [2, 4])
