@@ -9,8 +9,10 @@ eager allreduce (values summed across processes), ragged allgather
 (MPI_Allgatherv semantics), alltoall with ragged splits, broadcast from
 root, cross-process coordinated errors with engine reuse afterwards,
 checkpoint save/resume across processes, the torch DistributedOptimizer
-converging identically on all ranks, and one full run against the
-ThreadSanitizer build of the native engine.
+converging identically on all ranks, one full run against the
+ThreadSanitizer build of the native engine, and the COMPILED data plane
+across real process boundaries (jit/GSPMD psum + DistributedOptimizer on
+a 2-process x 4-device global mesh).
 """
 
 import os
@@ -36,11 +38,13 @@ def _free_port() -> int:
 
 
 # Common bootstrap: argv = [rank, jax_port, coord_port, nprocs].
-PRELUDE = textwrap.dedent("""
+def _prelude(device_count: int = 1) -> str:
+    return textwrap.dedent(f"""
     import os, sys
     rank = int(sys.argv[1]); jport = int(sys.argv[2]); cport = int(sys.argv[3])
     n = int(sys.argv[4])
-    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    os.environ["XLA_FLAGS"] = \\
+        "--xla_force_host_platform_device_count={device_count}"
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["HVD_TPU_COORDINATOR_HOST"] = "127.0.0.1"
     os.environ["HVD_TPU_COORDINATOR_PORT"] = str(cport)
@@ -50,10 +54,13 @@ PRELUDE = textwrap.dedent("""
     import numpy as np
     import horovod_tpu as hvd
 
-    hvd.init(coordinator_address=f"127.0.0.1:{jport}", num_processes=n,
+    hvd.init(coordinator_address=f"127.0.0.1:{{jport}}", num_processes=n,
              process_id=rank)
     assert hvd.size() == n and hvd.rank() == rank
 """)
+
+
+PRELUDE = _prelude()
 
 
 WORKER = PRELUDE + textwrap.dedent("""
@@ -448,6 +455,58 @@ def test_engine_under_tsan(nprocs):
         for chunk in err.split("WARNING: ThreadSanitizer")[1:]:
             assert "hvdcore" not in chunk.split("=" * 18)[0], (
                 f"tsan race in libhvdcore on rank {r}:\n{chunk[:4000]}")
+
+
+# The COMPILED data plane across real process boundaries: every other
+# multiprocess test exercises the eager engine; this one runs jit/GSPMD —
+# a global mesh spanning 2 processes x 4 CPU devices, a compiled psum, and
+# a DistributedOptimizer step whose in-graph gradient averaging crosses
+# the process boundary (the TPU-native centerpiece, which single-process
+# virtual-mesh tests can only simulate).
+COMPILED_WORKER = _prelude(device_count=4) + textwrap.dedent("""
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    d = 4 * n
+    assert jax.device_count() == d and hvd.num_chips() == d
+    assert hvd.local_num_chips() == 4
+
+    # Compiled psum across the process boundary.
+    sh = hvd.data_sharding(1)
+    x = jax.make_array_from_process_local_data(
+        sh, np.full(4, float(rank + 1), np.float32), (d,))
+    total = jax.jit(hvd.shard(lambda v: jax.lax.psum(v, "hvd"),
+                              in_specs=P("hvd"), out_specs=P()))(x)
+    expect = 4.0 * sum(r + 1 for r in range(n))
+    np.testing.assert_allclose(np.asarray(total.addressable_data(0)),
+                               np.full(1, expect))
+
+    # One DistributedOptimizer step: per-device gradients differ by
+    # process; the in-graph psum averages them and every process must end
+    # with identical parameters.
+    opt = hvd.DistributedOptimizer(optax.sgd(1.0))
+    params = {"w": jnp.zeros((2,), jnp.float32)}
+
+    def step(params, xb):
+        grads = {"w": jnp.broadcast_to(xb.mean(), (2,))}
+        state = opt.init(params)
+        updates, _ = opt.update(grads, state, params)
+        return optax.apply_updates(params, updates)
+
+    out = jax.jit(hvd.shard(step, in_specs=(P(), P("hvd")),
+                            out_specs=P()))(params, x)
+    mean_grad = sum(4 * (r + 1) for r in range(n)) / d
+    w = np.asarray(out["w"].addressable_data(0))
+    np.testing.assert_allclose(w, np.full(2, -mean_grad), rtol=1e-6)
+    allw = hvd.allgather_object(w.tolist())
+    assert all(a == allw[0] for a in allw), allw
+    print(f"RANK{rank} OK", flush=True)
+""")
+
+
+def test_compiled_gspmd_across_processes():
+    _run_workers(COMPILED_WORKER, 2)
 
 
 OBJ_WORKER = PRELUDE + textwrap.dedent("""
