@@ -73,9 +73,9 @@ def _job_bytes(mode: str, algo: str) -> int:
         except subprocess.TimeoutExpired:
             last_err = "job timeout"
             continue
-        if all(f"RANK{r} OK" in out for r, (out, _) in enumerate(outs)):
+        if all(f"RANK{r} OK" in out for r, (out, _, _) in enumerate(outs)):
             return _lo_rx_bytes() - before
-        last_err = "\n".join(err[-2000:] for _, err in outs)
+        last_err = "\n".join(err[-2000:] for _, err, _ in outs)
     raise AssertionError(f"wire-byte job {mode}/{algo} failed twice:\n"
                          f"{last_err}")
 
