@@ -68,6 +68,10 @@ def main():
                     help="capture an XLA profiler trace of one timed "
                          "dispatch into DIR (view in XProf/TensorBoard; "
                          "rank 0 only — horovod_tpu.profiling.trace)")
+    ap.add_argument("--bf16-params", action="store_true",
+                    help="keep parameters resident in bf16 with f32 master "
+                         "weights inside the optimizer state (kills the "
+                         "per-use f32->bf16 casts; adamw math stays f32)")
     args = ap.parse_args()
 
     hvd.init()
