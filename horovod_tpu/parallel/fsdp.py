@@ -15,9 +15,9 @@ stated in the paper).
 
 This is the TPU-native formulation (GSPMD): no wrapper module, no hooks, no
 manual prefetch ordering — the reference's world (SURVEY §2.9) replicates
-parameters on every rank and broadcasts at init
-(/root/reference/horovod/torch/__init__.py:185-301 broadcasts the full
-replicated state), so all of ZeRO is beyond-reference scope.  Usage:
+parameters on every rank and broadcasts at init (upstream
+horovod/torch/__init__.py:185-301 broadcasts the full replicated state),
+so all of ZeRO is beyond-reference scope.  Usage:
 
     shardings = fsdp_shardings((params, opt_state))      # pick specs
     params, opt_state = fsdp_device_put((params, opt_state), shardings)

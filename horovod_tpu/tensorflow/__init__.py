@@ -13,7 +13,7 @@ the numpy bridge exactly like the torch binding (mpi_ops.py here).
 from __future__ import annotations
 
 import itertools
-import math
+import weakref
 
 import numpy as np
 import tensorflow as tf
@@ -142,7 +142,9 @@ class _Int8ErrorFeedback:
     Same engine-grid pre-quantization as torch/optimizer.py
     ``_int8_with_ef``: add the carried residual, round onto the engine's
     own quantization grid (scale = max(amax/127, tiny) — core/qwire.py),
-    keep the new residual host-side, and ship the dequantized values; the
+    carry the new residual as an eager tensor in this process-wide
+    registry (all tensor-side — no per-gradient host sync), and ship the
+    dequantized values; the
     engine re-derives the identical scale (max |q| = 127), so q·s
     survives the wire bit-for-bit and the residual accounting holds.
     Eager-only: inside ``tf.function`` the residual state cannot be
@@ -151,6 +153,45 @@ class _Int8ErrorFeedback:
 
     def __init__(self):
         self._residuals: dict = {}
+        self._finalizers: dict = {}
+
+    def key_for(self, source, position):
+        """Residual key for a gradient source.
+
+        Variables (tf or keras — anything assignable) key by ``id`` — NOT
+        ``.ref()``, which holds a strong reference and would pin every
+        model ever trained in a long-lived process — with a
+        ``weakref.finalize`` evicting the residual when the variable is
+        collected.  A variable that cannot be weakref'd falls back to the
+        position key: an un-evictable ``id`` key would leak, and a
+        recycled address could attach a dead model's residual to a new
+        variable.  Non-variable sources key by flat position plus
+        shape/dtype, so two models watched in the same process cannot
+        cross-contaminate unless their tensors agree on position, shape,
+        AND dtype (and ``ship`` additionally resets on any shape/dtype
+        mismatch)."""
+        if isinstance(source, tf.Variable) or hasattr(source, "assign"):
+            key = id(source)
+            if key in self._finalizers:
+                return key
+            try:
+                self._finalizers[key] = weakref.finalize(
+                    source, self._evict, key)
+                return key
+            except TypeError:
+                pass
+        shape = getattr(source, "shape", None)
+        if shape is not None:
+            shape = (tuple(shape.as_list()) if hasattr(shape, "as_list")
+                     else tuple(shape))
+        dtype = getattr(source, "dtype", None)
+        if dtype is not None:
+            dtype = str(getattr(dtype, "name", dtype))
+        return (position, shape, dtype)
+
+    def _evict(self, key):
+        self._residuals.pop(key, None)
+        self._finalizers.pop(key, None)
 
     def ship(self, key, grad):
         if (not tf.executing_eagerly()
@@ -159,20 +200,36 @@ class _Int8ErrorFeedback:
             return grad
         g = tf.cast(grad, tf.float32)
         e = self._residuals.get(key)
-        if e is not None:
+        if e is not None and e.shape == g.shape and e.dtype == g.dtype:
             g = g + e
-        n = g.shape.num_elements()
-        amax = float(tf.reduce_max(tf.abs(g))) if n else 0.0
-        if not math.isfinite(amax):
-            # Non-finite step: reset the residual (a carried NaN would
-            # poison error feedback long after a loss scaler recovers) and
-            # ship as-is so the wire's NaN propagation fires.
-            self._residuals[key] = tf.zeros_like(g)
+        if not g.shape.num_elements():
             return tf.cast(g, grad.dtype)
-        s = max(amax / 127.0, np.finfo(np.float32).tiny)
-        shipped = tf.clip_by_value(tf.round(g / s), -127.0, 127.0) * s
-        self._residuals[key] = g - shipped
+        # All tensor-side: a host pull per gradient (float(amax)) would
+        # force a device sync per tensor and serialize the eager pipeline.
+        amax = tf.reduce_max(tf.abs(g))
+        finite = tf.math.is_finite(amax)
+        s = tf.maximum(amax / 127.0, np.finfo(np.float32).tiny)
+        q = tf.clip_by_value(tf.round(g / s), -127.0, 127.0) * s
+        # Non-finite step: reset the residual (a carried NaN would poison
+        # error feedback long after a loss scaler recovers) and ship as-is
+        # so the wire's NaN propagation fires.
+        shipped = tf.where(finite, q, g)
+        self._residuals[key] = tf.where(finite, g - shipped,
+                                        tf.zeros_like(g))
         return tf.cast(shipped, grad.dtype)
+
+
+# Residuals must outlive the tape wrapper: a ``tf.GradientTape`` is
+# one-shot, so the canonical loop builds a fresh ``DistributedGradientTape``
+# every step (examples/tensorflow_mnist.py) — instance-held state would be
+# discarded each step and EF would silently degrade to plain engine-grid
+# quantization.  One process-wide carrier instead, keyed by
+# variable identity (weakref-evicted on collection, so discarded models
+# don't pin residual memory) or by flat position+shape+dtype for
+# non-variable sources.  Variable-keyed residuals (the normal case) never
+# collide; position keys can only collide across two models whose watched
+# tensors agree on position, shape, and dtype.
+_TAPE_EF = _Int8ErrorFeedback()
 
 
 def _allreduce_grad_value(grad, compression, sparse_as_dense,
@@ -197,10 +254,12 @@ class _DistributedOptimizerV1(tf.compat.v1.train.Optimizer):
     graph, which cannot carry the host-side residual state (best for short
     or quantization-robust runs).  Error feedback (``_Int8ErrorFeedback``)
     engages only where gradients flow through EAGER Python: a custom loop
-    with ``DistributedGradientTape``, the keras ``DistributedOptimizer``
-    under ``run_eagerly=True`` (default ``model.fit`` compiles the train
-    step, where EF is inert), and always in the torch and optax wrappers.
-    Use those when training length makes quantization bias a concern."""
+    with ``DistributedGradientTape`` (residuals live in the process-wide
+    ``_TAPE_EF`` carrier, so they survive the per-step tape recreation),
+    the keras ``DistributedOptimizer`` under ``run_eagerly=True`` (default
+    ``model.fit`` compiles the train step, where EF is inert), and always
+    in the torch and optax wrappers.  Use those when training length makes
+    quantization bias a concern."""
 
     def __init__(self, optimizer, name=None, use_locking=False,
                  device_dense='', device_sparse='',
@@ -258,10 +317,17 @@ def _create_distributed_keras_class(cls, name=None,
                     ef = getattr(self, "_hvd_ef", None)
                     if ef is None:
                         ef = self._hvd_ef = _Int8ErrorFeedback()
-                    # keras passes the full gradient list in a stable
-                    # variable order every step — index keys the residual.
-                    grads = [g if g is None else ef.ship(i, g)
-                             for i, g in enumerate(grads)]
+                    # Key residuals by variable identity when keras hands
+                    # us the aligned variable list (robust to the list
+                    # shifting across fit calls, e.g. freezing layers);
+                    # fall back to position+shape+dtype keys otherwise.
+                    tvars = (trainable_variables
+                             or getattr(self, "_trainable_variables", None)
+                             or [])
+                    grads = [g if g is None else ef.ship(
+                        ef.key_for(tvars[i], i) if i < len(tvars) else i,
+                        g)
+                        for i, g in enumerate(grads)]
                 grads = [
                     _allreduce_grad_value(g, self._hvd_compression,
                                           self._hvd_sparse_as_dense)
@@ -328,7 +394,7 @@ class _DistributedGradientTape:
         self._device_sparse = device_sparse
         self._compression = compression
         self._sparse_as_dense = sparse_as_dense
-        self._ef = (_Int8ErrorFeedback()
+        self._ef = (_TAPE_EF
                     if compression is Compression.int8 else None)
 
     def __getattr__(self, item):
@@ -349,12 +415,12 @@ class _DistributedGradientTape:
             flat_g = tf.nest.flatten(grads)
             flat_s = tf.nest.flatten(sources)
             # Key residuals by variable identity when sources are
-            # variables (robust to call-order changes), else position.
-            # Position — NOT .ref() — for plain tensors: a watched tensor
-            # is typically a fresh object every step, so tensor-keyed
-            # residuals would never be reused and would accumulate.
-            keys = [s.ref() if isinstance(s, tf.Variable) else i
-                    for i, s in enumerate(flat_s)]
+            # variables (robust to call-order changes), else
+            # position+shape+dtype.  Not per-object for plain tensors: a
+            # watched tensor is typically a fresh object every step, so
+            # tensor-keyed residuals would never be reused and would
+            # accumulate.
+            keys = [self._ef.key_for(s, i) for i, s in enumerate(flat_s)]
             flat_g = [g if g is None else self._ef.ship(k, g)
                       for k, g in zip(keys, flat_g)]
             grads = tf.nest.pack_sequence_as(grads, flat_g)
