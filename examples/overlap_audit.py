@@ -1,0 +1,168 @@
+"""Audit: does XLA overlap the gradient AllReduce with backward compute?
+
+The scaling projection (docs/benchmarks.md) once listed comm/compute
+overlap inside the jitted step as a structural reason realized efficiency
+lands above the zero-overlap column.  This harness MEASURES that claim
+instead of assuming it, by compiling a real ``DistributedOptimizer`` step
+for a multi-chip target and inspecting the scheduled HLO:
+
+* per-bucket ``psum`` calls are issued in backward order (the reference's
+  hook-in-backward motivation, reference torch/__init__.py:83-112);
+* we then count what survives compilation: how many all-reduce ops the
+  backend's combiner left, whether any are async pairs
+  (``all-reduce-start``/``all-reduce-done``), and where they sit relative
+  to backward compute in the schedule.
+
+Run on a machine with the TPU plugin for the deviceless v5e:2x4 AOT audit
+(no chips needed — topology compile only), anywhere for the CPU-sim mesh:
+
+    python examples/overlap_audit.py            # both targets if available
+
+Measured result (recorded in docs/benchmarks.md, round 4): on current XLA
+the combiner merges every gradient bucket into ONE synchronous tuple
+all-reduce scheduled after all backward compute — zero HLO-level overlap,
+on both the TPU (v5e:2x4, RotatedPincer ring emitter) and CPU backends.
+The projection therefore uses its zero-overlap column as the operative
+number (it clears the ≥90 % bar regardless).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+
+
+def build_step():
+    import jax
+    import jax.numpy as jnp
+    import optax
+    import flax.linen as nn
+
+    import horovod_tpu as hvd
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            for i in range(8):
+                x = nn.Dense(1024, name=f"d{i}", dtype=jnp.bfloat16)(x)
+                x = nn.relu(x)
+            return nn.Dense(10, name="out", dtype=jnp.bfloat16)(x)
+
+    model = MLP()
+    # 4 MiB per-layer gradients + a small threshold force MULTIPLE buckets,
+    # each psum issued as soon as its bucket's gradients exist (backward
+    # order) — the structure that WOULD overlap if the backend kept it.
+    opt = hvd.DistributedOptimizer(optax.sgd(0.01),
+                                   threshold_bytes=2 * 1024 * 1024)
+
+    def step(params, opt_state, x, y):
+        def loss_fn(p):
+            logits = model.apply(p, x).astype(jnp.float32)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean()
+
+        g = jax.grad(loss_fn)(params)
+        u, opt_state = opt.update(g, opt_state, params)
+        return optax.apply_updates(params, u), opt_state
+
+    return model, opt, step
+
+
+def audit_text(txt: str) -> dict:
+    lines = txt.splitlines()
+    ar = [i for i, l in enumerate(lines)
+          if re.search(r"= .*all-reduce(\.|\()", l)]
+    ar_start = [i for i, l in enumerate(lines) if "all-reduce-start" in l]
+    bwd = [i for i, l in enumerate(lines) if "transpose(jvp" in l]
+    return {
+        "all_reduce_ops": len(ar),
+        "async_pairs": len(ar_start),
+        "first_all_reduce_line": ar[0] if ar else None,
+        "last_backward_line": max(bwd) if bwd else None,
+        "all_reduces_before_last_backward":
+            sum(1 for i in ar if bwd and i < max(bwd)),
+    }
+
+
+def audit_cpu_sim() -> dict:
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    model, opt, step = build_step()
+    x = jnp.zeros((16, 1024))
+    y = jnp.zeros((16,), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), x)
+    opt_state = opt.init(params)
+    sharded = hvd.shard(step,
+                        in_specs=(P(), P(), hvd.batch_spec(2),
+                                  hvd.batch_spec(1)),
+                        out_specs=(P(), P()))
+    lowered = jax.jit(sharded).lower(params, opt_state, x, y)
+    pre = lowered.as_text().count("all_reduce")
+    out = audit_text(lowered.compile().as_text())
+    out["stablehlo_all_reduces"] = pre
+    return out
+
+
+def audit_tpu_topology(topology: str = "v5e:2x4") -> dict:
+    """Deviceless AOT compile for a multi-chip TPU topology — inspects the
+    REAL TPU backend's scheduled module without needing the chips."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import topologies
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    topo = topologies.get_topology_desc(platform="tpu",
+                                        topology_name=topology)
+    mesh = Mesh(topo.devices, ("hvd",))
+    model, opt, step = build_step()
+
+    sharded = shard_map(step, mesh=mesh,
+                        in_specs=(P(), P(), P("hvd"), P("hvd")),
+                        out_specs=(P(), P()), check_rep=False)
+
+    pv = jax.eval_shape(model.init, jax.random.PRNGKey(0),
+                        jnp.zeros((1, 1024)))
+
+    def repl(t):
+        return jax.ShapeDtypeStruct(t.shape, t.dtype,
+                                    sharding=NamedSharding(mesh, P()))
+
+    ps = jax.tree.map(repl, pv)
+    os_ = jax.tree.map(repl, jax.eval_shape(opt.init, pv))
+    xs = jax.ShapeDtypeStruct((64, 1024), jnp.float32,
+                              sharding=NamedSharding(mesh, P("hvd")))
+    ys = jax.ShapeDtypeStruct((64,), jnp.int32,
+                              sharding=NamedSharding(mesh, P("hvd")))
+    lowered = jax.jit(sharded).lower(ps, os_, xs, ys)
+    pre = lowered.as_text().count("all_reduce")
+    out = audit_text(lowered.compile().as_text())
+    out["stablehlo_all_reduces"] = pre
+    out["topology"] = topology
+    return out
+
+
+def main():
+    import jax
+
+    results = {}
+    platform = jax.default_backend()
+    if platform == "cpu":
+        results["cpu_sim"] = audit_cpu_sim()
+    else:
+        try:
+            results["tpu_topology"] = audit_tpu_topology()
+        except Exception as e:  # topology compile unsupported here
+            results["tpu_topology_error"] = f"{type(e).__name__}: {e}"
+        results["cpu_sim"] = "run under JAX_PLATFORMS=cpu for the sim audit"
+    print(json.dumps(results, indent=1))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -57,7 +57,7 @@ for _mod, _names in {
     "horovod_tpu.training": (
         "DistributedOptimizer", "accumulate_gradients", "allgather_object",
         "broadcast_object", "broadcast_optimizer_state",
-        "broadcast_parameters", "scale_learning_rate",
+        "broadcast_parameters", "master_weights", "scale_learning_rate",
     ),
 }.items():
     for _n in _names:

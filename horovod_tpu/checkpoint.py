@@ -25,6 +25,30 @@ import numpy as np
 from horovod_tpu import basics, training
 
 
+def _rank() -> int:
+    """Rank, defaulting to 0 when ``hvd.init()`` was never called — the
+    inference/export path (docs/inference.md) restores checkpoints from
+    plain single-process programs with no distributed runtime at all.
+
+    The fallback engages ONLY in genuinely single-process programs: a
+    multi-process JAX job that forgot ``hvd.init()`` must keep the loud
+    ``NotInitializedError`` — otherwise every process would believe it is
+    rank 0 and race-write the same checkpoint directory."""
+    if basics.is_initialized():
+        return basics.rank()
+    if jax.process_count() > 1:
+        return basics.rank()  # raises NotInitializedError with direction
+    return 0
+
+
+def _size() -> int:
+    if basics.is_initialized():
+        return basics.size()
+    if jax.process_count() > 1:
+        return basics.size()  # raises NotInitializedError with direction
+    return 1
+
+
 def _lone_mp_options(prefix: str):
     """Subset-barrier options spanning ONLY the calling process, or None in
     single-process jobs.  Orbax's defaults sync across every JAX process on
@@ -100,7 +124,7 @@ def save(path: str | os.PathLike, state: Any, *, force: bool = True,
     pays orbax's one-time worker setup (~seconds) synchronously; steady-
     state kick cost is tens of milliseconds.
     """
-    if basics.rank() != 0:
+    if _rank() != 0:
         return
     path = os.path.abspath(os.fspath(path))
     # Rank-0-only writes (the reference contract) use a LONE-process orbax
@@ -260,12 +284,12 @@ def restore(path: str | os.PathLike, template: Any | None = None,
                         raise exc from None
             return ckptr.restore(p)
 
-    if basics.size() == 1 or not broadcast:
+    if _size() == 1 or not broadcast:
         return read()
     if template is not None:
-        local = read() if basics.rank() == root_rank else template
+        local = read() if _rank() == root_rank else template
         return training.broadcast_parameters(local, root_rank=root_rank)
-    state = read() if basics.rank() == root_rank else None
+    state = read() if _rank() == root_rank else None
     return training.broadcast_object(state, root_rank=root_rank)
 
 

@@ -31,6 +31,10 @@ class TransformerConfig:
     mlp_dim: int = 2048
     max_seq_len: int = 2048
     dtype: Any = jnp.bfloat16
+    # dtype the parameters are STORED in.  float32 (default) casts per use;
+    # jnp.bfloat16 makes params bf16-resident — pair with
+    # hvd.master_weights(...) so optimizer math keeps an f32 master copy.
+    param_dtype: Any = jnp.float32
     # attention_fn(q, k, v, causal) -> out; shapes [B, S, H, D].  None = dense
     # causal attention.  parallel/ring_attention.py provides a drop-in for
     # sequence-sharded q/k/v.
@@ -87,14 +91,15 @@ class Attention(nn.Module):
         cfg = self.cfg
         proj = lambda name: nn.DenseGeneral(  # noqa: E731
             (cfg.num_heads, cfg.head_dim), use_bias=False, dtype=cfg.dtype,
-            name=name)
+            param_dtype=cfg.param_dtype, name=name)
         q = rope(proj("q")(x), positions, cfg.rope_theta)
         k = rope(proj("k")(x), positions, cfg.rope_theta)
         v = proj("v")(x)
         attn = cfg.attention_fn or dense_causal_attention
         out = attn(q, k, v, causal=True)
         return nn.DenseGeneral(cfg.embed_dim, axis=(-2, -1), use_bias=False,
-                               dtype=cfg.dtype, name="o")(out)
+                               dtype=cfg.dtype,
+                               param_dtype=cfg.param_dtype, name="o")(out)
 
 
 class MLP(nn.Module):
@@ -104,10 +109,11 @@ class MLP(nn.Module):
     def __call__(self, x):
         cfg = self.cfg
         gate = nn.Dense(cfg.mlp_dim, use_bias=False, dtype=cfg.dtype,
-                        name="gate")(x)
+                        param_dtype=cfg.param_dtype, name="gate")(x)
         up = nn.Dense(cfg.mlp_dim, use_bias=False, dtype=cfg.dtype,
-                      name="up")(x)
+                      param_dtype=cfg.param_dtype, name="up")(x)
         return nn.Dense(cfg.embed_dim, use_bias=False, dtype=cfg.dtype,
+                        param_dtype=cfg.param_dtype,
                         name="down")(nn.silu(gate) * up)
 
 
@@ -117,9 +123,11 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x, positions):
         cfg = self.cfg
-        y = nn.RMSNorm(dtype=cfg.dtype, name="attn_norm")(x)
+        y = nn.RMSNorm(dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                       name="attn_norm")(x)
         x = x + Attention(cfg, name="attn")(y, positions)
-        y = nn.RMSNorm(dtype=cfg.dtype, name="mlp_norm")(x)
+        y = nn.RMSNorm(dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                       name="mlp_norm")(x)
         if cfg.moe_axis is not None:
             from horovod_tpu.models.moe import MoEMLP
 
@@ -147,7 +155,7 @@ class Transformer(nn.Module):
     def __call__(self, tokens, position_offset=0, positions=None):
         cfg = self.cfg
         x = nn.Embed(cfg.vocab_size, cfg.embed_dim, dtype=cfg.dtype,
-                     name="embed")(tokens)
+                     param_dtype=cfg.param_dtype, name="embed")(tokens)
         if positions is None:
             positions = (jnp.arange(tokens.shape[1])[None, :]
                          + jnp.asarray(position_offset))
@@ -157,11 +165,12 @@ class Transformer(nn.Module):
         block_cls = nn.remat(Block) if cfg.remat else Block
         for i in range(cfg.num_layers):
             x = block_cls(cfg, name=f"layer_{i}")(x, positions)
-        x = nn.RMSNorm(dtype=cfg.dtype, name="final_norm")(x)
+        x = nn.RMSNorm(dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                       name="final_norm")(x)
         # Head matmul in the compute dtype (bf16 hits the MXU at full rate;
         # f32 params, XLA accumulates in f32); logits upcast for the loss —
         # the standard LLM-trainer convention.  The f32 head matmul this
         # replaces was ~15% of step time (docs/benchmarks.md profile).
         logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
-                          name="lm_head")(x)
+                          param_dtype=cfg.param_dtype, name="lm_head")(x)
         return logits.astype(cfg.logits_dtype)

@@ -7,9 +7,13 @@ This is the TPU-native analog of the reference's L4 surface:
   allreduce, exactly what the reference's wrappers do for TF/torch/Keras
   (reference tensorflow/__init__.py:135-225, torch/__init__.py:42-150,
   keras/_impl.py:20-61).  Compression and a backward-pass-style bucketing
-  order are supported; on the compiled path XLA overlaps the resulting
-  AllReduces with remaining gradient computation, which is the reference's
-  motivation for doing allreduce inside backward hooks.
+  order are supported: buckets are issued as soon as their gradients exist
+  (the reference's backward-hook structure).  Measured caveat — current
+  XLA re-combines the bucket psums into one synchronous AllReduce after
+  backward, so there is no comm/compute overlap to credit on this
+  compiler version (examples/overlap_audit.py, tests/test_overlap.py;
+  docs/benchmarks.md appendix) — the scaling projection charges the full
+  serialized T_comm and still clears its target.
 * ``broadcast_parameters`` / ``broadcast_optimizer_state`` — pytree-wide
   broadcast from a root worker, the state-bootstrap contract every reference
   binding ships (torch/__init__.py:153-301, tensorflow/__init__.py:90-133,
@@ -123,6 +127,66 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
         grads = jax.tree.unflatten(treedef, reduced)
         updates, inner = optimizer.update(grads, state.inner, params, **extra)
         return updates, DistributedState(inner=inner)
+
+    return optax.GradientTransformation(init, update)
+
+
+class MasterWeightsState(NamedTuple):
+    """State for :func:`master_weights`: the wrapped optimizer's state plus
+    the full-precision master copy of every parameter."""
+
+    inner: Any
+    master: Any
+
+
+def master_weights(optimizer: optax.GradientTransformation,
+                   master_dtype=jnp.float32) -> optax.GradientTransformation:
+    """Mixed-precision wrapper: low-precision resident params, full-precision
+    master weights inside the optimizer state.
+
+    The standard LLM-trainer recipe for killing per-use dtype converts: keep
+    the *resident* parameters in the compute dtype (initialize the model
+    with ``param_dtype=jnp.bfloat16``), so the forward pass reads them
+    straight into the MXU with no f32→bf16 cast and the backward emits bf16
+    gradients with no bf16→f32 upcast — while all optimizer math (moments,
+    weight decay, the update itself) runs on an f32 master copy carried in
+    this wrapper's state, so training numerics match f32-resident params.
+
+    Per step: incoming (possibly bf16) gradients are upcast once, the inner
+    optimizer updates the master, and the emitted update is the bf16 delta
+    ``bf16(master') - param`` — ``optax.apply_updates`` then lands the
+    resident params exactly on ``bf16(master')`` (the delta-add round-trips
+    exactly whenever update ≪ param, by Sterbenz's lemma; in the rare
+    other case the resident copy is within 1 ulp and the master still
+    carries the truth, so no drift accumulates).
+
+    Compose inside :func:`DistributedOptimizer` so the wire carries the
+    half-width gradients::
+
+        opt = hvd.DistributedOptimizer(hvd.master_weights(optax.adamw(lr)))
+
+    The reference has no analog (fp16 on its wire was compression-only,
+    compression.py:42-63); this is TPU-first mixed precision in the
+    spirit of its ``Compression.fp16`` — but for residency, not just wire.
+    """
+
+    def init(params):
+        master = jax.tree.map(lambda p: p.astype(master_dtype), params)
+        return MasterWeightsState(inner=optimizer.init(master), master=master)
+
+    def update(grads, state, params=None, **extra):
+        if params is None:
+            raise ValueError(
+                "master_weights requires params: call "
+                "opt.update(grads, state, params)")
+        g = jax.tree.map(lambda t: t.astype(master_dtype), grads)
+        updates, inner_state = optimizer.update(g, state.inner, state.master,
+                                                **extra)
+        master = optax.apply_updates(state.master, updates)
+        emitted = jax.tree.map(
+            lambda m, p: (m.astype(p.dtype) - p).astype(p.dtype),
+            master, params)
+        return emitted, MasterWeightsState(inner=inner_state, master=master)
 
     return optax.GradientTransformation(init, update)
 

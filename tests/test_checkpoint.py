@@ -50,3 +50,51 @@ def test_background_saves_serialize(hvd, tmp_path):
     assert checkpoint.resume_epoch(tmp_path / "bgs") == 2
     out = checkpoint.restore_epoch(tmp_path / "bgs", 1)
     np.testing.assert_array_equal(out["x"], np.full(4, 1.0))
+
+
+def test_restore_without_init_single_chip(hvd, tmp_path):
+    """The inference/export contract (docs/inference.md): a checkpoint
+    saved by a (distributed) training process restores and serves in a
+    plain single-process program that NEVER calls hvd.init()."""
+    import json
+    import subprocess
+    import sys
+
+    import jax
+
+    from horovod_tpu.models import Transformer, TransformerConfig
+
+    cfg = TransformerConfig(vocab_size=64, num_layers=1, num_heads=2,
+                            head_dim=8, embed_dim=16, mlp_dim=32,
+                            max_seq_len=8)
+    model = Transformer(cfg)
+    tokens = jnp.arange(8, dtype=jnp.int32)[None, :] % 64
+    params = model.init(jax.random.PRNGKey(0), tokens)
+    want = np.asarray(model.apply(params, tokens), np.float32)
+    # Train-side save includes optimizer state; serving keeps params only.
+    checkpoint.save(tmp_path / "export", {"params": params})
+
+    prog = f"""
+import sys, json
+import numpy as np
+import jax, jax.numpy as jnp
+import horovod_tpu.checkpoint as checkpoint
+import horovod_tpu as hvd
+from horovod_tpu.models import Transformer, TransformerConfig
+
+assert not hvd.is_initialized()
+state = checkpoint.restore({str(tmp_path / "export")!r})
+assert not hvd.is_initialized()  # restore must not drag init in
+cfg = TransformerConfig(vocab_size=64, num_layers=1, num_heads=2,
+                        head_dim=8, embed_dim=16, mlp_dim=32, max_seq_len=8)
+tokens = jnp.arange(8, dtype=jnp.int32)[None, :] % 64
+out = Transformer(cfg).apply(state["params"], tokens)
+print("RESULT " + json.dumps(np.asarray(out, np.float32).ravel().tolist()))
+"""
+    proc = subprocess.run([sys.executable, "-c", prog], capture_output=True,
+                          text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = [ln for ln in proc.stdout.splitlines()
+            if ln.startswith("RESULT ")][-1]
+    got = np.array(json.loads(line[len("RESULT "):]), np.float32)
+    np.testing.assert_allclose(got, want.ravel(), rtol=1e-5, atol=1e-5)

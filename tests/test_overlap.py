@@ -1,0 +1,51 @@
+"""Pin the measured comm/compute-overlap behavior of the compiled data
+plane (VERDICT r3 item 2: verify, don't assume, the overlap the scaling
+projection once leaned on).
+
+Measured reality (examples/overlap_audit.py, recorded in
+docs/benchmarks.md round 4): the DistributedOptimizer step issues one
+psum per fusion bucket in backward order, but XLA's all-reduce combiner
+merges them into a SINGLE synchronous all-reduce scheduled after all
+backward compute — zero HLO-level overlap, on both the real TPU backend
+(deviceless v5e:2x4 AOT audit) and the CPU sim.  The projection's
+zero-overlap column is therefore the operative number.
+
+These tests pin that structure on the CPU sim so a future XLA that
+starts splitting/async-scheduling gradient all-reduces (start/done pairs
+interleaved with backward fusions) flips them loudly — at which point the
+projection text should be upgraded, not the code.
+"""
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def audit():
+    import horovod_tpu as hvd
+
+    hvd.init()
+    from examples.overlap_audit import audit_cpu_sim
+
+    return audit_cpu_sim()
+
+
+def test_buckets_issued_before_combining(audit):
+    # The repo side really does emit multiple bucket psums (backward
+    # order); whatever the backend does next, the structure XLA COULD
+    # overlap is present in the lowered program.
+    assert audit["stablehlo_all_reduces"] >= 3
+
+
+def test_backend_combines_to_single_sync_all_reduce(audit):
+    # The measured (non-)overlap: one combined all-reduce, no async
+    # start/done pairs, scheduled after the last backward op.  If this
+    # starts failing, XLA began overlapping — update the scaling
+    # projection in docs/benchmarks.md to claim the measured overlap.
+    assert audit["all_reduce_ops"] == 1, (
+        "XLA kept multiple all-reduces — re-audit overlap "
+        f"(examples/overlap_audit.py): {audit}")
+    assert audit["async_pairs"] == 0, (
+        f"XLA now emits async all-reduce pairs — overlap exists: {audit}")
+    assert audit["all_reduces_before_last_backward"] == 0, (
+        f"an all-reduce now precedes backward compute in the schedule — "
+        f"overlap exists: {audit}")

@@ -168,3 +168,81 @@ def test_accumulate_gradients_has_aux(hvd):
     # full-batch sum here
     np.testing.assert_allclose(float(aux), float(faux) / 2, rtol=1e-6)
     np.testing.assert_allclose(grads["w"], fgrads["w"], rtol=1e-6)
+
+
+def test_master_weights_tracks_f32_training(hvd):
+    """bf16-resident params + f32 master must track pure-f32 adamw training:
+    the master copy evolves EXACTLY like f32 training on the same (bf16-
+    rounded) gradients, and resident params land on bf16(master) each step."""
+    import ml_dtypes
+
+    key = jax.random.PRNGKey(0)
+    w32 = jax.random.normal(key, (16, 8), jnp.float32) * 0.1
+    params16 = {"w": w32.astype(jnp.bfloat16)}
+    params32 = {"w": params16["w"].astype(jnp.float32)}  # same start point
+
+    inner = optax.adamw(1e-2)
+    mw = hvd.master_weights(inner)
+    s16 = mw.init(params16)
+    s32 = inner.init(params32)
+
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 16))
+
+    for i in range(10):
+        # Identical bf16 gradients feed both paths (the wrapper upcasts).
+        g16 = jax.grad(lambda p: jnp.sum(
+            (x.astype(jnp.bfloat16) @ p["w"]) ** 2).astype(jnp.float32))(
+            params16)
+        g32 = {"w": g16["w"].astype(jnp.float32)}
+
+        u16, s16 = mw.update(g16, s16, params16)
+        assert u16["w"].dtype == jnp.bfloat16  # delta emitted in param dtype
+        params16 = optax.apply_updates(params16, u16)
+
+        u32, s32 = inner.update(g32, s32, params32)
+        params32 = optax.apply_updates(params32, u32)
+
+        # master == the f32 training trajectory, bit-for-bit
+        np.testing.assert_array_equal(np.asarray(s16.master["w"]),
+                                      np.asarray(params32["w"]))
+        # resident params land on bf16(master) (1-ulp slack for the rare
+        # non-Sterbenz delta-add; exact in practice)
+        np.testing.assert_allclose(
+            np.asarray(params16["w"], np.float32),
+            np.asarray(s16.master["w"]).astype(ml_dtypes.bfloat16)
+            .astype(np.float32),
+            rtol=0.008, atol=4e-5)
+
+
+def test_master_weights_requires_params(hvd):
+    mw = hvd.master_weights(optax.sgd(0.1))
+    p = {"w": jnp.ones(3, jnp.bfloat16)}
+    s = mw.init(p)
+    assert s.master["w"].dtype == jnp.float32
+    with pytest.raises(ValueError, match="master_weights requires params"):
+        mw.update({"w": jnp.zeros(3, jnp.bfloat16)}, s)
+
+
+def test_master_weights_composes_with_distributed_optimizer(hvd):
+    """hvd.DistributedOptimizer(hvd.master_weights(adamw)) inside a sharded
+    step: bf16 grads ride the wire, master update is averaged-gradient
+    exact."""
+    n = hvd.num_chips()
+    opt = hvd.DistributedOptimizer(hvd.master_weights(optax.sgd(0.1)))
+    params = {"w": jnp.ones((8, 4), jnp.bfloat16)}
+
+    @hvd.shard(in_specs=(P(), hvd.batch_spec(2)), out_specs=P())
+    def step(params, x):
+        def loss(p):
+            return jnp.sum((x.astype(jnp.bfloat16) @ p["w"]).astype(
+                jnp.float32) ** 2) / x.shape[0]
+        grads = jax.grad(loss)(params)
+        state = opt.init(params)
+        updates, _ = opt.update(grads, state, params)
+        return optax.apply_updates(params, updates)
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (n * 2, 8), jnp.float32)
+    out = step(params, x)
+    assert out["w"].dtype == jnp.bfloat16
+    assert not np.array_equal(np.asarray(out["w"], np.float32),
+                              np.ones((8, 4), np.float32))
