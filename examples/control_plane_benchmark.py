@@ -1,0 +1,85 @@
+"""Control-plane scaling microbench (VERDICT r3 item 7).
+
+The eager engine's coordinator is a rank-0 TCP star: every tick gathers
+one frame per worker and broadcasts responses sequentially
+(core/src/controller.cc Gather/Bcast loops).  The reference used
+MPI_Gather/Bcast, whose implementations tree these (log P); the question
+is where the sequential star's ceiling is.  This harness measures, at a
+given ``-np``:
+
+* **rendezvous_s** — wall time of ``hvd.init()`` (socket accept quorum);
+* **per_op_ms** — latency of a lone tiny allreduce (one negotiation
+  round trip + the device dispatch floor);
+* **names_per_s** — throughput when SATURATED with many outstanding
+  tiny tensors (100 async enqueues per round): the negotiation batching
+  amortizes ticks, so this isolates the coordinator's frame-handling
+  rate from the cycle time.
+
+Run under the launcher at increasing widths and compare:
+
+    python -m horovod_tpu.run -np 4 -- \
+        python examples/control_plane_benchmark.py
+
+Numbers recorded in docs/benchmarks.md (round 4) with the projected
+star ceiling.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+import horovod_tpu as hvd
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=30)
+    ap.add_argument("--burst", type=int, default=100,
+                    help="outstanding async tensors per saturated round")
+    ap.add_argument("--warmup", type=int, default=5)
+    args = ap.parse_args()
+
+    t0 = time.perf_counter()
+    hvd.init()
+    rendezvous_s = time.perf_counter() - t0
+
+    x = np.ones(4, np.float32)
+
+    # Warmup (engine start, first negotiation).
+    for i in range(args.warmup):
+        hvd.allreduce(x, name=f"warm.{i}")
+
+    # Lone-op latency: one tensor in flight — a full negotiate+dispatch
+    # round trip per call.
+    t0 = time.perf_counter()
+    for i in range(args.rounds):
+        hvd.allreduce(x, name=f"lone.{i}")
+    per_op_ms = (time.perf_counter() - t0) / args.rounds * 1e3
+
+    # Saturated: burst of async enqueues, then synchronize all — the
+    # coordinator sees many names per tick and batches them.
+    t0 = time.perf_counter()
+    for r in range(args.rounds):
+        handles = [hvd.allreduce_async(x, name=f"burst.{r}.{i}")
+                   for i in range(args.burst)]
+        for h in handles:
+            hvd.synchronize(h)
+    dt = time.perf_counter() - t0
+    names_per_s = args.rounds * args.burst / dt
+
+    if hvd.rank() == 0:
+        print(json.dumps({
+            "np": hvd.size(),
+            "rendezvous_s": round(rendezvous_s, 3),
+            "per_op_ms": round(per_op_ms, 3),
+            "names_per_s": round(names_per_s, 1),
+        }), flush=True)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

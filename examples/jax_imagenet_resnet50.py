@@ -108,9 +108,9 @@ def main():
             yield (rng_np.rand(gb, 224, 224, 3).astype(np.float32),
                    rng_np.randint(0, 1000, gb).astype(np.int32))
 
+    loss = None
     for epoch in range(resume + 1, args.epochs):
         t0 = time.time()
-        loss = None
         for x, y in hvd.data.prefetch_to_device(
                 hvd.data.BackgroundLoader(synthetic_batches())):
             params, batch_stats, opt_state, loss = train_step(
@@ -126,6 +126,13 @@ def main():
                                   {"params": params,
                                    "batch_stats": batch_stats},
                                   background=True)
+
+    if loss is not None:
+        # Every rank reports the globally-averaged final metric (identical
+        # by construction) — the launcher tests assert cross-rank agreement.
+        final = float(hvd.allreduce(jnp.asarray(float(loss)), average=True))
+        print(f"[rank {hvd.rank()}/{hvd.size()}] final loss={final:.6f}",
+              flush=True)
 
 
 if __name__ == "__main__":

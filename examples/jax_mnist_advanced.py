@@ -96,6 +96,12 @@ def main():
         if hvd.rank() == 0:
             print(f"epoch {epoch}: {logs} lr={float(lr):.5f}")
 
+    # Every rank reports the globally-averaged final metric (identical by
+    # construction) — the launcher tests assert cross-rank agreement.
+    final = float(hvd.allreduce(jnp.asarray(float(loss)), average=True))
+    print(f"[rank {hvd.rank()}/{hvd.size()}] final loss={final:.6f}",
+          flush=True)
+
 
 if __name__ == "__main__":
     main()

@@ -61,14 +61,20 @@ def main():
                          "(jax.checkpoint) — required for very long S")
     ap.add_argument("--peak-tflops", type=float, default=197.0,
                     help="bf16 peak of the chip (v5e default)")
-    ap.add_argument("--steps-per-call", type=int, default=4,
+    ap.add_argument("--steps-per-call", type=int, default=8,
                     help="training steps per dispatched program (lax.scan "
-                         "device loop — amortizes per-dispatch latency, "
-                         "same as bench.py's BENCH_STEPS_PER_CALL)")
+                         "device loop — amortizes per-dispatch latency; "
+                         "8 matches bench.py's BENCH_STEPS_PER_CALL "
+                         "protocol, measured +0.4 MFU pts over 4)")
     ap.add_argument("--profile", default=None, metavar="DIR",
                     help="capture an XLA profiler trace of one timed "
                          "dispatch into DIR (view in XProf/TensorBoard; "
                          "rank 0 only — horovod_tpu.profiling.trace)")
+    ap.add_argument("--fused-norm", action="store_true",
+                    help="opt into the fused Pallas RMSNorm kernels "
+                         "(measured ~3.4 MFU pts SLOWER than XLA's native "
+                         "fusion at this geometry — docs/benchmarks.md; "
+                         "default is the plain jnp path)")
     ap.add_argument("--bf16-params", action="store_true",
                     help="keep parameters resident in bf16 with f32 master "
                          "weights inside the optimizer state (kills the "
@@ -83,6 +89,7 @@ def main():
                remat=args.remat,
                param_dtype=(jnp.bfloat16 if args.bf16_params
                             else jnp.float32),
+               fused_norm=True if args.fused_norm else None,
                # bf16 logits buffer (f32 softmax via the fused upcast below)
                logits_dtype=jnp.bfloat16)
     attn = None if args.no_flash else make_flash_attention(

@@ -50,11 +50,11 @@ def build_step():
             return nn.Dense(10, name="out", dtype=jnp.bfloat16)(x)
 
     model = MLP()
-    # 4 MiB per-layer gradients + a small threshold force MULTIPLE buckets,
-    # each psum issued as soon as its bucket's gradients exist (backward
-    # order) — the structure that WOULD overlap if the backend kept it.
-    opt = hvd.DistributedOptimizer(optax.sgd(0.01),
-                                   threshold_bytes=2 * 1024 * 1024)
+    # In-mesh the optimizer emits one psum per gradient tensor (XLA's
+    # combiner owns batching), each issued as soon as its gradient exists
+    # (backward order) — the structure that WOULD overlap if the backend
+    # kept the collectives separate.
+    opt = hvd.DistributedOptimizer(optax.sgd(0.01))
 
     def step(params, opt_state, x, y):
         def loss_fn(p):

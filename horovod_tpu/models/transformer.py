@@ -20,6 +20,8 @@ from typing import Any, Callable
 import flax.linen as nn
 import jax.numpy as jnp
 
+from horovod_tpu.ops.rmsnorm import FusedRMSNorm
+
 
 @dataclasses.dataclass(frozen=True)
 class TransformerConfig:
@@ -52,6 +54,12 @@ class TransformerConfig:
     # traffic at large vocab; bfloat16 halves it — upcast inside your loss
     # (the cast fuses into the softmax chain, nothing f32 is materialized).
     logits_dtype: Any = jnp.float32
+    # RMSNorm implementation: False/None (default) = pure jnp — measured
+    # FASTER than the fused Pallas kernels inside the block (XLA fuses
+    # the norm with neighboring work; ops/rmsnorm.py docstring has the
+    # numbers).  True opts into the kernels.  Same parameter structure
+    # either way.
+    fused_norm: bool | None = None
     # Rematerialize each block in the backward pass (jax.checkpoint):
     # activation memory drops from O(L) layer working sets to one layer +
     # L boundary tensors — the FLOPs-for-HBM trade long-context training
@@ -123,11 +131,11 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x, positions):
         cfg = self.cfg
-        y = nn.RMSNorm(dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-                       name="attn_norm")(x)
+        y = FusedRMSNorm(dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                         use_fused=cfg.fused_norm, name="attn_norm")(x)
         x = x + Attention(cfg, name="attn")(y, positions)
-        y = nn.RMSNorm(dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-                       name="mlp_norm")(x)
+        y = FusedRMSNorm(dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                         use_fused=cfg.fused_norm, name="mlp_norm")(x)
         if cfg.moe_axis is not None:
             from horovod_tpu.models.moe import MoEMLP
 
@@ -165,8 +173,8 @@ class Transformer(nn.Module):
         block_cls = nn.remat(Block) if cfg.remat else Block
         for i in range(cfg.num_layers):
             x = block_cls(cfg, name=f"layer_{i}")(x, positions)
-        x = nn.RMSNorm(dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-                       name="final_norm")(x)
+        x = FusedRMSNorm(dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                         use_fused=cfg.fused_norm, name="final_norm")(x)
         # Head matmul in the compute dtype (bf16 hits the MXU at full rate;
         # f32 params, XLA accumulates in f32); logits upcast for the loss —
         # the standard LLM-trainer convention.  The f32 head matmul this

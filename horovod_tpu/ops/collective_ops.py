@@ -330,8 +330,11 @@ def quantized_grouped_allreduce(tensors: Sequence, errors: Sequence | None = Non
 def grouped_allreduce(tensors: Sequence, average: bool = True,
                       compression=Compression.none,
                       threshold_bytes: int | None = None) -> list:
-    """Fused allreduce of many tensors via flat buckets (reference fusion
-    buffer semantics, operations.cc:1807-1842; see ops/fusion.py)."""
+    """Fused allreduce of many tensors (reference fusion-buffer semantics,
+    operations.cc:1807-1842).  In-mesh: one psum per tensor — XLA's
+    all-reduce combiner does the batching, and ``threshold_bytes`` is
+    ignored (docs/tensor-fusion.md).  Eager, and the int8 path in either
+    context: flat ``threshold_bytes``-bounded buckets (ops/fusion.py)."""
     if compression is Compression.int8:
         # Stateless quantized path (no error feedback): residuals dropped.
         reduced, _ = quantized_grouped_allreduce(
@@ -341,9 +344,17 @@ def grouped_allreduce(tensors: Sequence, average: bool = True,
     comp = [compression.compress(t) for t in tensors]
     if axes is not None:
         denom = _data_width(axes)
-        reduced = fusion.fused_apply(
-            [c for c, _ in comp],
-            lambda flat: _mesh_allreduce(flat, axes), threshold_bytes)
+        # Compiled path: one psum per tensor — NO concat packing.  XLA's
+        # all-reduce combiner already merges adjacent psums into a single
+        # tuple-shaped AllReduce (measured on real v5e lowering:
+        # RotatedPincer ring emitter, examples/overlap_audit.py), so the
+        # reference-style flat fusion buffer duplicates the combiner's
+        # work and charges a pack+unpack pass over every gradient byte —
+        # removing it measured +2.5 MFU points on the 162M transformer
+        # (docs/benchmarks.md round 4).  The fusion buffer remains the
+        # EAGER engine's mechanism below, where per-collective dispatch
+        # latency is real (reference operations.cc:743-767 motivation).
+        reduced = [_mesh_allreduce(c, axes) for c, _ in comp]
     else:
         _require_not_traced("grouped_allreduce")
         denom = basics.size()

@@ -1,0 +1,193 @@
+"""Fused RMSNorm — one-pass Pallas kernels, measured and (for now) benched
+OFF by default.
+
+Motivation (docs/benchmarks.md round-4 profile): the RMSNorm-adjacent
+`multiply_reduce` fusions measured 27.7 ms/step on the 162M transformer,
+suggesting a one-pass fused kernel.  MEASURED RESULT: the kernel version
+is ~3.4 MFU points SLOWER than XLA's native lowering at that geometry
+(61.9 % vs 65.3 % at S=1024/B=32, both with per-block dγ partials so the
+grid pipelines) — those XLA fusions turn out to carry neighboring work
+(residual adds, dtype casts, matmul epilogues) that a pallas_call
+boundary forces back into separate HBM passes, costing more than the
+norm's own re-reads saved.  So ``FusedRMSNorm``/``TransformerConfig``
+default to the pure-jnp path, and the kernels stay as an opt-in
+(``use_fused=True`` / ``fused_norm=True``) for geometries where the norm
+really is isolated, with numerics pinned either way.  The kernels read
+each [tokens, E] tile once and produce all outputs in that pass:
+
+* forward: mean-of-squares, rsqrt, scale — f32 statistics, output in the
+  input dtype (the flax ``RMSNorm(dtype=bf16)`` contract);
+* backward: recomputes the per-token rsqrt from the resident tile (an
+  FMA per element — cheaper than a second HBM pass to save it), emits
+  ``dx = r·(g − x̂·mean(g·x̂))`` with ``g = dy·γ``, and writes per-block
+  ``dγ`` partials the caller sums (a revisited VMEM accumulator would
+  serialize the grid — Mosaic cannot double-buffer a block revisited
+  every step).
+
+The reference has no analog (its norms belong to TF/torch).  Numerics
+are pinned against the pure-jnp reference implementation
+(tests/test_rmsnorm.py); non-TPU backends run Pallas interpret mode.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+DEFAULT_EPS = 1e-6
+_BLOCK_TOKENS = 512
+
+
+def _fwd_kernel(x_ref, scale_ref, y_ref, *, eps):
+    xf = x_ref[0].astype(jnp.float32)
+    inv = jax.lax.rsqrt(jnp.mean(xf * xf, axis=1, keepdims=True) + eps)
+    y_ref[0] = (xf * inv * scale_ref[...].astype(jnp.float32)
+                ).astype(y_ref.dtype)
+
+
+def _bwd_kernel(x_ref, dy_ref, scale_ref, dx_ref, dscale_ref, *, eps):
+    xf = x_ref[0].astype(jnp.float32)
+    dyf = dy_ref[0].astype(jnp.float32)
+    inv = jax.lax.rsqrt(jnp.mean(xf * xf, axis=1, keepdims=True) + eps)
+    xhat = xf * inv
+    g = dyf * scale_ref[...].astype(jnp.float32)
+    s = jnp.mean(g * xhat, axis=1, keepdims=True)
+    dx_ref[0] = (inv * (g - xhat * s)).astype(dx_ref.dtype)
+    # Per-block dγ partial, summed by the caller: a revisited VMEM
+    # accumulator here would serialize the grid (Mosaic cannot
+    # double-buffer an output block revisited every step).  Written
+    # sublane-replicated to satisfy the (8, 128) tile minimum — the
+    # caller reads row 0 of each block (same trick as the flash kernels'
+    # lse outputs).
+    partial = jnp.sum(dyf * xhat, axis=0)
+    dscale_ref[0] = jnp.broadcast_to(partial[None, :], dscale_ref.shape[1:])
+
+
+def _flatten_pad(x, block):
+    n = x.shape[0]
+    pad = (-n) % block
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+    return x, n
+
+
+def _rms_norm_fwd_impl(x2d, scale, eps, interpret):
+    xp, n = _flatten_pad(x2d, _BLOCK_TOKENS)
+    grid = (xp.shape[0] // _BLOCK_TOKENS,)
+    e = x2d.shape[1]
+    y = pl.pallas_call(
+        functools.partial(_fwd_kernel, eps=eps),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, _BLOCK_TOKENS, e), lambda i: (0, i, 0)),
+            pl.BlockSpec((e,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((1, _BLOCK_TOKENS, e), lambda i: (0, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((1,) + xp.shape, x2d.dtype),
+        interpret=interpret,
+    )(xp[None], scale)
+    return y[0, :n]
+
+
+def _rms_norm_bwd_impl(x2d, scale, dy2d, eps, interpret):
+    xp, n = _flatten_pad(x2d, _BLOCK_TOKENS)
+    # Padded dy rows are zero, so they contribute nothing to dγ.
+    dyp, _ = _flatten_pad(dy2d, _BLOCK_TOKENS)
+    grid = (xp.shape[0] // _BLOCK_TOKENS,)
+    e = x2d.shape[1]
+    dx, dscale = pl.pallas_call(
+        functools.partial(_bwd_kernel, eps=eps),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, _BLOCK_TOKENS, e), lambda i: (0, i, 0)),
+            pl.BlockSpec((1, _BLOCK_TOKENS, e), lambda i: (0, i, 0)),
+            pl.BlockSpec((e,), lambda i: (0,)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, _BLOCK_TOKENS, e), lambda i: (0, i, 0)),
+            pl.BlockSpec((1, 8, e), lambda i: (i, 0, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((1,) + xp.shape, x2d.dtype),
+            jax.ShapeDtypeStruct((grid[0], 8, e), jnp.float32),
+        ),
+        interpret=interpret,
+    )(xp[None], dyp[None], scale)
+    return dx[0, :n], jnp.sum(dscale[:, 0, :], axis=0)
+
+
+def rms_norm_reference(x, scale, eps: float = DEFAULT_EPS):
+    """Pure-jnp RMSNorm (f32 statistics, input-dtype output) — the
+    numerics contract the kernels are pinned against, and the off-TPU
+    fallback path of :class:`FusedRMSNorm`."""
+    xf = x.astype(jnp.float32)
+    inv = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * inv * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def rms_norm(x, scale, eps: float = DEFAULT_EPS,
+             interpret: bool | None = None):
+    """Fused RMSNorm over the last axis.  ``x``: [..., E]; ``scale``: [E].
+
+    Reverse-mode only (``custom_vjp``).  ``interpret=None`` selects the
+    compiled kernel on TPU and Pallas interpret mode elsewhere.
+    """
+    y, _ = _rms_norm_fwd(x, scale, eps, interpret)
+    return y
+
+
+def _resolve(interpret):
+    return jax.default_backend() != "tpu" if interpret is None else interpret
+
+
+def _rms_norm_fwd(x, scale, eps, interpret):
+    e = x.shape[-1]
+    y = _rms_norm_fwd_impl(x.reshape(-1, e), scale, eps,
+                           _resolve(interpret))
+    # Residuals are just the inputs: the backward recomputes the rsqrt
+    # from the resident tile instead of spending an HBM round-trip on it.
+    return y.reshape(x.shape), (x, scale)
+
+
+def _rms_norm_bwd(eps, interpret, res, dy):
+    x, scale = res
+    e = x.shape[-1]
+    dx, dscale = _rms_norm_bwd_impl(x.reshape(-1, e), scale,
+                                    dy.reshape(-1, e), eps,
+                                    _resolve(interpret))
+    return dx.reshape(x.shape), dscale.astype(scale.dtype)
+
+
+rms_norm.defvjp(_rms_norm_fwd, _rms_norm_bwd)
+
+
+class FusedRMSNorm:
+    """Flax-module-shaped wrapper: ``FusedRMSNorm(dtype=..., param_dtype=...,
+    name=...)(x)`` with the same parameter structure as ``nn.RMSNorm``
+    (one ``scale`` vector), so checkpoints interchange freely.
+
+    Implemented as a thin flax module factory to avoid importing flax at
+    module import time."""
+
+    def __new__(cls, dtype=jnp.float32, param_dtype=jnp.float32,
+                epsilon: float = DEFAULT_EPS, use_fused: bool | None = None,
+                name: str | None = None):
+        import flax.linen as nn
+
+        class _FusedRMSNorm(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                scale = self.param("scale", nn.initializers.ones,
+                                   (x.shape[-1],), param_dtype)
+                x = x.astype(dtype)
+                # Default False: measured slower than XLA's native fusion
+                # inside the transformer block (module docstring).
+                if use_fused:
+                    return rms_norm(x, scale, epsilon)
+                return rms_norm_reference(x, scale, epsilon)
+
+        return _FusedRMSNorm(name=name)

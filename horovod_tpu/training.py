@@ -68,10 +68,12 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
 
     Drop-in: ``opt = hvd.DistributedOptimizer(optax.sgd(lr))`` — the analog of
     the reference's ``hvd.DistributedOptimizer(tf.train.AdagradOptimizer(...))``
-    (reference README.md:159-163).  Gradients are packed into flat same-dtype
-    buckets of at most ``HOROVOD_FUSION_THRESHOLD`` bytes and reduced with one
-    ``psum`` per bucket (ops/fusion.py), reproducing the reference's fusion
-    buffer win at the HLO level.
+    (reference README.md:159-163).  In-mesh, gradients reduce with one
+    ``psum`` per tensor and XLA's all-reduce combiner supplies the fusion
+    (measured equivalent to the reference's fusion buffer, minus a
+    pack/unpack pass — docs/tensor-fusion.md); ``threshold_bytes`` /
+    ``HOROVOD_FUSION_THRESHOLD`` shape the EAGER path's flat buckets and
+    the in-mesh int8 path's quantization groups (ops/fusion.py).
 
     Use inside a step wrapped by :func:`horovod_tpu.shard` (in-mesh) or in a
     plain eager loop (process-level reduction) — same dual contexts as

@@ -192,3 +192,35 @@ def test_weak_scaling_benchmark_np2():
         assert r["workers"] == 2
         assert r["wire_model_mb_per_rank_per_step"] == 1.0
         assert r["steps_per_s_per_rank"] > 0
+
+
+def test_jax_mnist_advanced_np2():
+    """The full callback stack (warmup, metric averaging, broadcast,
+    schedules) under the launcher — reference CI runs keras_mnist_advanced
+    under mpirun (.travis.yml:113-131)."""
+    out = _run_np2("jax_mnist_advanced.py", timeout=scaled(560))
+    assert "[0]: " in out and "[1]: " in out
+    assert "finished gradual learning rate warmup" in out
+    vals = _final_metrics(out)
+    assert vals[0] == vals[1], vals
+
+
+@pytest.mark.slow
+def test_jax_imagenet_resnet50_np2_resume(tmp_path):
+    """Checkpoint/resume + epoch broadcast across real process boundaries:
+    run 1 trains epoch 0 and saves; run 2 broadcasts the resume epoch from
+    rank 0, restores, and trains only epoch 1."""
+    ck = str(tmp_path / "r50np2")
+    out1 = _run_np2("jax_imagenet_resnet50.py", "--epochs", "1",
+                    "--steps-per-epoch", "1", "--batch-size", "2",
+                    "--ckpt-dir", ck, timeout=scaled(560))
+    assert "epoch 0" in out1
+    vals = _final_metrics(out1)
+    assert vals[0] == vals[1], vals
+    out2 = _run_np2("jax_imagenet_resnet50.py", "--epochs", "2",
+                    "--steps-per-epoch", "1", "--batch-size", "2",
+                    "--ckpt-dir", ck, timeout=scaled(560))
+    assert "resumed from epoch 0" in out2
+    assert "epoch 1:" in out2 and "epoch 0:" not in out2
+    vals = _final_metrics(out2)
+    assert vals[0] == vals[1], vals

@@ -1,0 +1,108 @@
+"""Fused RMSNorm kernels (ops/rmsnorm.py): numerics pinned against the
+pure-jnp reference (and flax's nn.RMSNorm), padding paths, and the
+revisited-accumulator dγ."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.ops.rmsnorm import (FusedRMSNorm, rms_norm,
+                                     rms_norm_reference)
+
+
+def _ref_loss(x, scale):
+    return jnp.sum(rms_norm_reference(x, scale).astype(jnp.float32) ** 2)
+
+
+def _fused_loss(x, scale):
+    return jnp.sum(rms_norm(x, scale).astype(jnp.float32) ** 2)
+
+
+@pytest.mark.parametrize("n,e", [(512, 256), (1024, 768), (300, 384)])
+def test_forward_matches_reference(hvd, n, e):
+    """Includes n=300: the non-multiple-of-block path exercises padding."""
+    x = jax.random.normal(jax.random.PRNGKey(0), (n, e), jnp.float32)
+    scale = jax.random.normal(jax.random.PRNGKey(1), (e,)) * 0.1 + 1.0
+    np.testing.assert_allclose(np.asarray(rms_norm(x, scale)),
+                               np.asarray(rms_norm_reference(x, scale)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_forward_bf16_dtype(hvd):
+    x = jax.random.normal(jax.random.PRNGKey(0), (256, 256), jnp.bfloat16)
+    scale = jnp.ones((256,), jnp.float32)
+    y = rms_norm(x, scale)
+    assert y.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(y, np.float32),
+        np.asarray(rms_norm_reference(x, scale), np.float32))
+
+
+def test_backward_matches_reference(hvd):
+    x = jax.random.normal(jax.random.PRNGKey(2), (640, 256), jnp.float32)
+    scale = jax.random.normal(jax.random.PRNGKey(3), (256,)) * 0.1 + 1.0
+    gx_ref, gs_ref = jax.grad(_ref_loss, argnums=(0, 1))(x, scale)
+    gx, gs = jax.grad(_fused_loss, argnums=(0, 1))(x, scale)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(gx_ref),
+                               rtol=2e-4, atol=2e-4)
+    # dγ runs through the revisited VMEM accumulator across grid steps
+    # (640 tokens = 2 blocks — both accumulate).
+    np.testing.assert_allclose(np.asarray(gs), np.asarray(gs_ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_backward_padded_tokens_do_not_pollute_dscale(hvd):
+    """n=100 pads to one 512 block; padded dy rows are zero and must not
+    contribute to dγ."""
+    x = jax.random.normal(jax.random.PRNGKey(4), (100, 128), jnp.float32)
+    scale = jnp.ones((128,))
+    gs = jax.grad(_fused_loss, argnums=1)(x, scale)
+    gs_ref = jax.grad(_ref_loss, argnums=1)(x, scale)
+    np.testing.assert_allclose(np.asarray(gs), np.asarray(gs_ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_leading_batch_dims(hvd):
+    x = jax.random.normal(jax.random.PRNGKey(5), (4, 96, 256), jnp.float32)
+    scale = jnp.ones((256,))
+    np.testing.assert_allclose(np.asarray(rms_norm(x, scale)),
+                               np.asarray(rms_norm_reference(x, scale)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_module_matches_flax_rmsnorm(hvd):
+    """FusedRMSNorm (both paths) ≈ nn.RMSNorm, and the parameter structure
+    is identical (one 'scale' leaf) so checkpoints interchange."""
+    x = jax.random.normal(jax.random.PRNGKey(6), (64, 128), jnp.float32)
+    flax_mod = nn.RMSNorm(epsilon=1e-6)
+    flax_params = flax_mod.init(jax.random.PRNGKey(7), x)
+
+    for use_fused in (False, True):
+        mod = FusedRMSNorm(use_fused=use_fused)
+        params = mod.init(jax.random.PRNGKey(7), x)
+        assert (jax.tree.structure(params)
+                == jax.tree.structure(flax_params))
+        got = mod.apply(flax_params, x)  # flax params drive ours directly
+        want = flax_mod.apply(flax_params, x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_transformer_uses_same_param_structure(hvd):
+    """fused_norm True/False produce identical parameter trees for the
+    Transformer (resume across the toggle)."""
+    from horovod_tpu.models import Transformer, TransformerConfig
+
+    kw = dict(vocab_size=64, num_layers=1, num_heads=2, head_dim=8,
+              embed_dim=16, mlp_dim=32, max_seq_len=8)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    p_fused = Transformer(TransformerConfig(**kw, fused_norm=True)).init(
+        jax.random.PRNGKey(0), tokens)
+    p_plain = Transformer(TransformerConfig(**kw, fused_norm=False)).init(
+        jax.random.PRNGKey(0), tokens)
+    assert jax.tree.structure(p_fused) == jax.tree.structure(p_plain)
+    for a, b in zip(jax.tree.leaves(p_fused), jax.tree.leaves(p_plain)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32))
