@@ -51,10 +51,12 @@ def main() -> None:
             env.get("XLA_FLAGS", "")
             + f" --xla_force_host_platform_device_count={args.width}")
         env["_INT8_CONV_CHILD"] = "1"
-        env["PYTHONPATH"] = ":".join(
-            p for p in env.get("PYTHONPATH", "").split(":")
-            if p and ".axon_site" not in p) or os.path.dirname(
-                os.path.dirname(os.path.abspath(__file__)))
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        kept = [p for p in env.get("PYTHONPATH", "").split(":")
+                if p and ".axon_site" not in p]
+        # Always include the repo root: the child's sys.path[0] is
+        # examples/, not the repo.
+        env["PYTHONPATH"] = ":".join(kept + [repo])
         sys.exit(subprocess.run([sys.executable, os.path.abspath(__file__)]
                                 + sys.argv[1:], env=env).returncode)
 

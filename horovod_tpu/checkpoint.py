@@ -97,6 +97,22 @@ def _get_async_checkpointer():
             else:
                 _async_ckptr = ocp.AsyncCheckpointer(
                     ocp.PyTreeCheckpointHandler())
+            # Wait for in-flight commits BEFORE interpreter teardown.
+            # Plain atexit is too late on Python ≥3.9: threading._shutdown
+            # (which runs concurrent.futures' _python_exit and flips its
+            # global "no new futures" flag) executes before atexit
+            # handlers, and orbax's commit thread schedules futures via
+            # asyncio.to_thread — a background save still committing at
+            # exit would die with "cannot schedule new futures after
+            # shutdown".  threading._register_atexit callbacks run LIFO
+            # before _python_exit (registered earlier at import), so the
+            # commit finishes while executors still accept work.  Regular
+            # atexit stays as a fallback (wait_pending is idempotent).
+            import threading
+
+            register = getattr(threading, "_register_atexit",
+                               atexit.register)
+            register(wait_pending)
             atexit.register(wait_pending)
         return _async_ckptr
 

@@ -331,9 +331,10 @@ def grouped_allreduce(tensors: Sequence, average: bool = True,
                       compression=Compression.none,
                       threshold_bytes: int | None = None) -> list:
     """Fused allreduce of many tensors (reference fusion-buffer semantics,
-    operations.cc:1807-1842).  In-mesh: one psum per tensor — XLA's
-    all-reduce combiner does the batching, and ``threshold_bytes`` is
-    ignored (docs/tensor-fusion.md).  Eager, and the int8 path in either
+    operations.cc:1807-1842).  In-mesh on a single axis: one psum per
+    tensor — XLA's all-reduce combiner does the batching, and
+    ``threshold_bytes`` is ignored (docs/tensor-fusion.md).  Hierarchical
+    (multi-axis) meshes, the eager path, and the int8 path in any
     context: flat ``threshold_bytes``-bounded buckets (ops/fusion.py)."""
     if compression is Compression.int8:
         # Stateless quantized path (no error feedback): residuals dropped.
@@ -344,17 +345,27 @@ def grouped_allreduce(tensors: Sequence, average: bool = True,
     comp = [compression.compress(t) for t in tensors]
     if axes is not None:
         denom = _data_width(axes)
-        # Compiled path: one psum per tensor — NO concat packing.  XLA's
-        # all-reduce combiner already merges adjacent psums into a single
-        # tuple-shaped AllReduce (measured on real v5e lowering:
-        # RotatedPincer ring emitter, examples/overlap_audit.py), so the
-        # reference-style flat fusion buffer duplicates the combiner's
-        # work and charges a pack+unpack pass over every gradient byte —
-        # removing it measured +2.5 MFU points on the 162M transformer
-        # (docs/benchmarks.md round 4).  The fusion buffer remains the
-        # EAGER engine's mechanism below, where per-collective dispatch
-        # latency is real (reference operations.cc:743-767 motivation).
-        reduced = [_mesh_allreduce(c, axes) for c, _ in comp]
+        if len(axes) == 1:
+            # Single-axis compiled path: one psum per tensor — NO concat
+            # packing.  XLA's all-reduce combiner already merges adjacent
+            # psums into a single tuple-shaped AllReduce (measured on real
+            # v5e lowering: RotatedPincer ring emitter,
+            # examples/overlap_audit.py), so the reference-style flat
+            # fusion buffer duplicates the combiner's work and charges a
+            # pack+unpack pass over every gradient byte — removing it
+            # measured +2.5 MFU points on the 162M transformer
+            # (docs/benchmarks.md round 4).
+            reduced = [_mesh_allreduce(c, axes) for c, _ in comp]
+        else:
+            # Hierarchical (e.g. (dcn, ici)) route: each tensor lowers to
+            # a psum_scatter→psum→all_gather CHAIN (parallel/hierarchy.py)
+            # that the AR combiner does not merge across tensors — keep
+            # the flat buckets here so many small leaves ride few tiered
+            # chains instead of one latency-bound chain each.  (The
+            # combiner measurement above covers only plain AllReduce.)
+            reduced = fusion.fused_apply(
+                [c for c, _ in comp],
+                lambda flat: _mesh_allreduce(flat, axes), threshold_bytes)
     else:
         _require_not_traced("grouped_allreduce")
         denom = basics.size()

@@ -68,12 +68,13 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
 
     Drop-in: ``opt = hvd.DistributedOptimizer(optax.sgd(lr))`` — the analog of
     the reference's ``hvd.DistributedOptimizer(tf.train.AdagradOptimizer(...))``
-    (reference README.md:159-163).  In-mesh, gradients reduce with one
-    ``psum`` per tensor and XLA's all-reduce combiner supplies the fusion
-    (measured equivalent to the reference's fusion buffer, minus a
-    pack/unpack pass — docs/tensor-fusion.md); ``threshold_bytes`` /
-    ``HOROVOD_FUSION_THRESHOLD`` shape the EAGER path's flat buckets and
-    the in-mesh int8 path's quantization groups (ops/fusion.py).
+    (reference README.md:159-163).  In-mesh on a single axis, gradients
+    reduce with one ``psum`` per tensor and XLA's all-reduce combiner
+    supplies the fusion (measured equivalent to the reference's fusion
+    buffer, minus a pack/unpack pass — docs/tensor-fusion.md);
+    ``threshold_bytes`` / ``HOROVOD_FUSION_THRESHOLD`` shape the flat
+    buckets everywhere they remain: the eager path, hierarchical
+    multi-axis meshes, and the int8 quantization groups (ops/fusion.py).
 
     Use inside a step wrapped by :func:`horovod_tpu.shard` (in-mesh) or in a
     plain eager loop (process-level reduction) — same dual contexts as

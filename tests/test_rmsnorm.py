@@ -1,6 +1,6 @@
 """Fused RMSNorm kernels (ops/rmsnorm.py): numerics pinned against the
 pure-jnp reference (and flax's nn.RMSNorm), padding paths, and the
-revisited-accumulator dγ."""
+per-block dγ partials the caller sums."""
 
 import flax.linen as nn
 import jax
@@ -47,8 +47,8 @@ def test_backward_matches_reference(hvd):
     gx, gs = jax.grad(_fused_loss, argnums=(0, 1))(x, scale)
     np.testing.assert_allclose(np.asarray(gx), np.asarray(gx_ref),
                                rtol=2e-4, atol=2e-4)
-    # dγ runs through the revisited VMEM accumulator across grid steps
-    # (640 tokens = 2 blocks — both accumulate).
+    # dγ accumulates from per-block partial outputs summed by the caller
+    # (640 tokens = 2 blocks — both contribute).
     np.testing.assert_allclose(np.asarray(gs), np.asarray(gs_ref),
                                rtol=2e-4, atol=2e-4)
 
