@@ -108,8 +108,6 @@ def _get_async_checkpointer():
             # before _python_exit (registered earlier at import), so the
             # commit finishes while executors still accept work.  Regular
             # atexit stays as a fallback (wait_pending is idempotent).
-            import threading
-
             register = getattr(threading, "_register_atexit",
                                atexit.register)
             register(wait_pending)

@@ -59,17 +59,19 @@ def _lo_rx_bytes() -> int:
     raise AssertionError("no loopback interface in /proc/net/dev")
 
 
-def _job_bytes(mode: str, algo: str) -> int:
+def _job_bytes(mode: str, algo: str | None = None,
+               worker: str = WIRE_WORKER) -> int:
     """Loopback rx bytes for one 4-process job.  Retries infra noise with a
     FRESH counter read — a silent whole-job retry under one measurement
     would double-count traffic and corrupt the ratio assertions."""
-    env = {"WB_MODE": mode, "WB_ELEMS": str(ELEMS), "WB_ITERS": str(ITERS),
-           "HVD_TPU_EAGER_REDUCE": algo}
+    env = {"WB_MODE": mode, "WB_ELEMS": str(ELEMS), "WB_ITERS": str(ITERS)}
+    if algo is not None:
+        env["HVD_TPU_EAGER_REDUCE"] = algo
     last_err = ""
     for _attempt in range(2):
         before = _lo_rx_bytes()
         try:
-            outs = _run_workers_once(WIRE_WORKER, NPROCS, scaled(300), env)
+            outs = _run_workers_once(worker, NPROCS, scaled(300), env)
         except subprocess.TimeoutExpired:
             last_err = "job timeout"
             continue
@@ -110,3 +112,47 @@ def test_device_reduce_halves_wire_bytes():
     # int8 wire is ~4x leaner than the dense wire on the same route.
     comp_ratio = results[("dense", "device")] / results[("int8", "device")]
     assert comp_ratio >= 2.5, f"int8 compression only {comp_ratio:.2f}x"
+
+
+OPT_WORKER = PRELUDE + """
+import jax.numpy as jnp
+import numpy as np
+import optax
+N = int(os.environ["WB_ELEMS"])
+K = int(os.environ["WB_ITERS"])
+# A full DistributedOptimizer training step on the eager path: many
+# leaves of mixed sizes totalling N f32 elements, so the wire carries
+# the production (bucketed) gradient payload, not one raw collective.
+sizes = [N // 2, N // 4, N // 8, N - (N // 2 + N // 4 + N // 8)]
+rng = np.random.RandomState(rank)
+params = {f"p{i}": jnp.asarray(rng.rand(s).astype(np.float32))
+          for i, s in enumerate(sizes)}
+opt = hvd.DistributedOptimizer(optax.sgd(0.01))
+state = opt.init(params)
+for k in range(K):
+    grads = {f"p{i}": jnp.asarray(rng.rand(s).astype(np.float32) - 0.5)
+             for i, s in enumerate(sizes)}
+    updates, state = opt.update(grads, state, params)
+    params = optax.apply_updates(params, updates)
+hvd.barrier(name="wbopt.done")
+print(f"RANK{rank} OK", flush=True)
+"""
+
+
+@pytest.mark.skipif(not os.path.exists("/proc/net/dev"),
+                    reason="needs /proc/net/dev")
+def test_distributed_optimizer_step_matches_ring_model():
+    """The scaling projection's wire model, asserted for the FULL
+    DistributedOptimizer step (not just raw collectives): K eager steps
+    over V bytes of gradients at P ranks must move ≈ 2·(P−1)·V·K total
+    loopback bytes (ring reduce-scatter → allgather), within framing
+    margins.  VERDICT r3 weak-item 5."""
+    overhead = _job_bytes("idle")
+    measured = _job_bytes("opt", worker=OPT_WORKER) - overhead
+    model = 2 * (NPROCS - 1) * ELEMS * 4 * ITERS
+    ratio = measured / model
+    print(f"optimizer step: measured {measured/1e6:.1f} MB, ring model "
+          f"{model/1e6:.1f} MB ({ratio:.2f}x)")
+    # Ring-optimal within framing/control noise; far below the P-1=3x of
+    # a naive gather transport.
+    assert 0.8 <= ratio <= 1.6, f"optimizer wire {ratio:.2f}x of ring model"
