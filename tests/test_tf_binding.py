@@ -373,3 +373,28 @@ def test_host_plane_limitation_documented():
     for fn in (hvd_tf.DistributedOptimizer, hvd_tf.DistributedGradientTape):
         doc = fn.__doc__ or ""
         assert "py_function" in doc and "SavedModel" in doc, fn.__name__
+
+
+def test_ef_key_for_keras_variable(hvdtf):
+    """The keras apply path keys residuals through key_for with keras
+    Variables (not tf.Variable): identity-keyed via weakref when possible,
+    with eviction on collection."""
+    import gc
+
+    import keras
+
+    from horovod_tpu.tensorflow import _Int8ErrorFeedback
+
+    ef = _Int8ErrorFeedback()
+    v = keras.Variable(np.ones(3, np.float32))
+    key = ef.key_for(v, 0)
+    if isinstance(key, int) and key == id(v):
+        # weakref-able keras variable: identity key + finalizer eviction
+        ef._residuals[key] = tf.zeros(3)
+        del v
+        gc.collect()
+        assert key not in ef._residuals
+        assert key not in ef._finalizers
+    else:
+        # non-weakref-able fallback: position+shape+dtype tuple
+        assert key[0] == 0 and tuple(key[1]) == (3,)
