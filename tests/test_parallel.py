@@ -211,7 +211,6 @@ def test_three_axis_dp_hierarchical_sp_composition(hvd):
     collectives over "sp", and their non-interference."""
     import optax
 
-    import horovod_tpu as hvd_mod
     from horovod_tpu.models import Transformer, TransformerConfig
     from horovod_tpu.parallel import make_ring_attention
 
@@ -228,7 +227,7 @@ def test_three_axis_dp_hierarchical_sp_composition(hvd):
     s_local = S // 2
     tokens = jax.random.randint(jax.random.PRNGKey(1), (B, S), 0, 32)
     params = dense_model.init(jax.random.PRNGKey(2), tokens[:1, :s_local])
-    opt = hvd_mod.DistributedOptimizer(optax.sgd(0.1))
+    opt = hvd.DistributedOptimizer(optax.sgd(0.1))
     opt_state = opt.init(params)
 
     def step(params, opt_state, toks):
