@@ -168,6 +168,12 @@ def master_weights(optimizer: optax.GradientTransformation,
 
         opt = hvd.DistributedOptimizer(hvd.master_weights(optax.adamw(lr)))
 
+    Also composes with ``compression=Compression.int8`` (tested): the
+    error-feedback residuals then live in the gradient dtype (bf16 when
+    params are bf16-resident), so the carried residual is itself
+    bf16-rounded — one extra quantization level below the int8 wire's,
+    negligible against it.
+
     The reference has no analog (fp16 on its wire was compression-only,
     compression.py:42-63); this is TPU-first mixed precision in the
     spirit of its ``Compression.fp16`` — but for residency, not just wire.

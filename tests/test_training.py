@@ -246,3 +246,36 @@ def test_master_weights_composes_with_distributed_optimizer(hvd):
     assert out["w"].dtype == jnp.bfloat16
     assert not np.array_equal(np.asarray(out["w"], np.float32),
                               np.ones((8, 4), np.float32))
+
+
+def test_master_weights_composes_with_int8_ef(hvd):
+    """The full mixed-precision + compressed-wire stack in one optimizer:
+    DistributedOptimizer(master_weights(adamw), compression=int8).  Pins
+    that the three state layers coexist (bf16 resident params, f32 master
+    copy, error-feedback residuals in the gradient dtype) and training
+    makes progress through the quantized wire."""
+    params = {"w": jnp.ones((64, 32), jnp.bfloat16) * 0.5}
+    opt = hvd.DistributedOptimizer(hvd.master_weights(optax.adamw(1e-2)),
+                                   compression=hvd.Compression.int8)
+    state = opt.init(params)
+
+    @hvd.shard(in_specs=(P(), P(), hvd.batch_spec(2)),
+               out_specs=(P(), P(), P()))
+    def step(params, state, x):
+        def loss(p):
+            return jnp.sum((x.astype(jnp.bfloat16) @ p["w"]).astype(
+                jnp.float32) ** 2)
+
+        l, g = jax.value_and_grad(loss)(params)
+        u, state = opt.update(g, state, params)
+        return optax.apply_updates(params, u), state, l
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (2 * hvd.num_chips(), 64))
+    p2, s2, l1 = step(params, state, x)
+    p3, s3, l2 = step(p2, s2, x)
+    assert p2["w"].dtype == jnp.bfloat16
+    assert s2.inner.master["w"].dtype == jnp.float32  # master inside EF state
+    # EF residuals carry in the gradient dtype (bf16 here — the residual
+    # itself is quantized one level further; documented trade).
+    assert jax.tree.leaves(s2.error)[0].dtype == jnp.bfloat16
+    assert float(l2) < float(l1)
