@@ -25,18 +25,46 @@ import numpy as np
 from horovod_tpu import basics, training
 
 
+def _multiprocess_env() -> bool:
+    """The launcher/JAX environment says this job spans processes, WITHOUT
+    touching the XLA backend.  Launcher-spawned workers
+    (``python -m horovod_tpu.run -np N``) have ``jax.process_count() == 1``
+    until ``hvd.init()`` runs ``jax.distributed.initialize`` — but their
+    environment already carries the job shape (run.py:67-71), so a worker
+    that forgot ``hvd.init()`` is still detected here and gets the loud
+    ``NotInitializedError`` instead of racing as rank 0.  Checking env
+    first also keeps restore-before-init from initializing the backend as
+    a side effect (``jax.distributed.initialize`` refuses to run after the
+    backend is touched).
+
+    An explicit ``JAX_NUM_PROCESSES`` is authoritative: the launcher sets
+    coordinator addresses even for ``-np 1`` (run.py:67-71) and children
+    inherit them, so a lone worker — or a single-process export/eval
+    subprocess it spawns — must still get the rank-0 fallback."""
+    nproc = os.environ.get("JAX_NUM_PROCESSES")
+    if nproc is not None:
+        try:
+            return int(nproc) > 1
+        except ValueError:
+            return True  # malformed value: be loud rather than race
+    return bool(os.environ.get("JAX_COORDINATOR_ADDRESS")
+                or os.environ.get("HVD_TPU_COORDINATOR_HOST"))
+
+
 def _rank() -> int:
     """Rank, defaulting to 0 when ``hvd.init()`` was never called — the
     inference/export path (docs/inference.md) restores checkpoints from
     plain single-process programs with no distributed runtime at all.
 
     The fallback engages ONLY in genuinely single-process programs: a
-    multi-process JAX job that forgot ``hvd.init()`` must keep the loud
-    ``NotInitializedError`` — otherwise every process would believe it is
+    multi-process job that forgot ``hvd.init()`` — whether already
+    JAX-initialized or merely launcher-spawned (env signals, see
+    :func:`_multiprocess_env`) — must keep the loud
+    ``NotInitializedError``; otherwise every process would believe it is
     rank 0 and race-write the same checkpoint directory."""
     if basics.is_initialized():
         return basics.rank()
-    if jax.process_count() > 1:
+    if _multiprocess_env() or jax.process_count() > 1:
         return basics.rank()  # raises NotInitializedError with direction
     return 0
 
@@ -44,7 +72,7 @@ def _rank() -> int:
 def _size() -> int:
     if basics.is_initialized():
         return basics.size()
-    if jax.process_count() > 1:
+    if _multiprocess_env() or jax.process_count() > 1:
         return basics.size()  # raises NotInitializedError with direction
     return 1
 

@@ -38,7 +38,25 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 DEFAULT_EPS = 1e-6
-_BLOCK_TOKENS = 512
+_MAX_BLOCK_TOKENS = 512
+_VMEM_BUDGET_BYTES = 12 * 1024 * 1024  # of ~16 MiB/core; Mosaic headroom
+
+
+def _block_tokens(e: int, block: int | None = None) -> int:
+    """Token-block size for a given embed dim: the largest power of two
+    (≤512, ≥8) whose backward working set fits the VMEM budget.  The
+    backward keeps ~10 f32 [block, E] tiles resident (x/dy/dx double-
+    buffered by Mosaic plus xhat/g intermediates), so a fixed 512 block
+    spills or fails to compile once E reaches ~4k; scaling the block down
+    keeps the kernel compilable at any width.  ``block`` overrides
+    (explicit geometry escape hatch, exposed through
+    :func:`rms_norm`/:class:`FusedRMSNorm`)."""
+    if block is not None:
+        return block
+    b = _MAX_BLOCK_TOKENS
+    while b > 8 and b * e * 4 * 10 > _VMEM_BUDGET_BYTES:
+        b //= 2
+    return b
 
 
 def _fwd_kernel(x_ref, scale_ref, y_ref, *, eps):
@@ -74,40 +92,42 @@ def _flatten_pad(x, block):
     return x, n
 
 
-def _rms_norm_fwd_impl(x2d, scale, eps, interpret):
-    xp, n = _flatten_pad(x2d, _BLOCK_TOKENS)
-    grid = (xp.shape[0] // _BLOCK_TOKENS,)
+def _rms_norm_fwd_impl(x2d, scale, eps, interpret, block=None):
+    bt = _block_tokens(x2d.shape[1], block)
+    xp, n = _flatten_pad(x2d, bt)
+    grid = (xp.shape[0] // bt,)
     e = x2d.shape[1]
     y = pl.pallas_call(
         functools.partial(_fwd_kernel, eps=eps),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, _BLOCK_TOKENS, e), lambda i: (0, i, 0)),
+            pl.BlockSpec((1, bt, e), lambda i: (0, i, 0)),
             pl.BlockSpec((e,), lambda i: (0,)),
         ],
-        out_specs=pl.BlockSpec((1, _BLOCK_TOKENS, e), lambda i: (0, i, 0)),
+        out_specs=pl.BlockSpec((1, bt, e), lambda i: (0, i, 0)),
         out_shape=jax.ShapeDtypeStruct((1,) + xp.shape, x2d.dtype),
         interpret=interpret,
     )(xp[None], scale)
     return y[0, :n]
 
 
-def _rms_norm_bwd_impl(x2d, scale, dy2d, eps, interpret):
-    xp, n = _flatten_pad(x2d, _BLOCK_TOKENS)
+def _rms_norm_bwd_impl(x2d, scale, dy2d, eps, interpret, block=None):
+    bt = _block_tokens(x2d.shape[1], block)
+    xp, n = _flatten_pad(x2d, bt)
     # Padded dy rows are zero, so they contribute nothing to dγ.
-    dyp, _ = _flatten_pad(dy2d, _BLOCK_TOKENS)
-    grid = (xp.shape[0] // _BLOCK_TOKENS,)
+    dyp, _ = _flatten_pad(dy2d, bt)
+    grid = (xp.shape[0] // bt,)
     e = x2d.shape[1]
     dx, dscale = pl.pallas_call(
         functools.partial(_bwd_kernel, eps=eps),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, _BLOCK_TOKENS, e), lambda i: (0, i, 0)),
-            pl.BlockSpec((1, _BLOCK_TOKENS, e), lambda i: (0, i, 0)),
+            pl.BlockSpec((1, bt, e), lambda i: (0, i, 0)),
+            pl.BlockSpec((1, bt, e), lambda i: (0, i, 0)),
             pl.BlockSpec((e,), lambda i: (0,)),
         ],
         out_specs=(
-            pl.BlockSpec((1, _BLOCK_TOKENS, e), lambda i: (0, i, 0)),
+            pl.BlockSpec((1, bt, e), lambda i: (0, i, 0)),
             pl.BlockSpec((1, 8, e), lambda i: (i, 0, 0)),
         ),
         out_shape=(
@@ -128,15 +148,17 @@ def rms_norm_reference(x, scale, eps: float = DEFAULT_EPS):
     return (xf * inv * scale.astype(jnp.float32)).astype(x.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
 def rms_norm(x, scale, eps: float = DEFAULT_EPS,
-             interpret: bool | None = None):
+             interpret: bool | None = None, block: int | None = None):
     """Fused RMSNorm over the last axis.  ``x``: [..., E]; ``scale``: [E].
 
     Reverse-mode only (``custom_vjp``).  ``interpret=None`` selects the
     compiled kernel on TPU and Pallas interpret mode elsewhere.
+    ``block`` pins the token-block size; default auto-scales with the
+    embed dim to stay inside VMEM (:func:`_block_tokens`).
     """
-    y, _ = _rms_norm_fwd(x, scale, eps, interpret)
+    y, _ = _rms_norm_fwd(x, scale, eps, interpret, block)
     return y
 
 
@@ -144,21 +166,21 @@ def _resolve(interpret):
     return jax.default_backend() != "tpu" if interpret is None else interpret
 
 
-def _rms_norm_fwd(x, scale, eps, interpret):
+def _rms_norm_fwd(x, scale, eps, interpret, block):
     e = x.shape[-1]
     y = _rms_norm_fwd_impl(x.reshape(-1, e), scale, eps,
-                           _resolve(interpret))
+                           _resolve(interpret), block)
     # Residuals are just the inputs: the backward recomputes the rsqrt
     # from the resident tile instead of spending an HBM round-trip on it.
     return y.reshape(x.shape), (x, scale)
 
 
-def _rms_norm_bwd(eps, interpret, res, dy):
+def _rms_norm_bwd(eps, interpret, block, res, dy):
     x, scale = res
     e = x.shape[-1]
     dx, dscale = _rms_norm_bwd_impl(x.reshape(-1, e), scale,
                                     dy.reshape(-1, e), eps,
-                                    _resolve(interpret))
+                                    _resolve(interpret), block)
     return dx.reshape(x.shape), dscale.astype(scale.dtype)
 
 
@@ -175,7 +197,7 @@ class FusedRMSNorm:
 
     def __new__(cls, dtype=jnp.float32, param_dtype=jnp.float32,
                 epsilon: float = DEFAULT_EPS, use_fused: bool | None = None,
-                name: str | None = None):
+                name: str | None = None, *, block_tokens: int | None = None):
         import flax.linen as nn
 
         class _FusedRMSNorm(nn.Module):
@@ -187,7 +209,7 @@ class FusedRMSNorm:
                 # Default False: measured slower than XLA's native fusion
                 # inside the transformer block (module docstring).
                 if use_fused:
-                    return rms_norm(x, scale, epsilon)
+                    return rms_norm(x, scale, epsilon, None, block_tokens)
                 return rms_norm_reference(x, scale, epsilon)
 
         return _FusedRMSNorm(name=name)

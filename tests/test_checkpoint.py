@@ -3,6 +3,7 @@ resume (reference contract per SURVEY §5 checkpoint/resume)."""
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from horovod_tpu import checkpoint
 
@@ -50,6 +51,50 @@ def test_background_saves_serialize(hvd, tmp_path):
     assert checkpoint.resume_epoch(tmp_path / "bgs") == 2
     out = checkpoint.restore_epoch(tmp_path / "bgs", 1)
     np.testing.assert_array_equal(out["x"], np.full(4, 1.0))
+
+
+def test_uninitialized_multiprocess_env_is_loud(hvd, tmp_path, monkeypatch):
+    """Advisor r4 (medium): a launcher-spawned worker that forgot
+    ``hvd.init()`` has ``jax.process_count() == 1`` (distributed init
+    happens inside init), but its environment carries the job shape —
+    the rank-0 fallback must NOT engage there, or every worker would
+    race-write the same checkpoint directory."""
+    from horovod_tpu import basics
+
+    def _not_init():
+        raise basics.NotInitializedError()
+
+    monkeypatch.setattr(basics, "is_initialized", lambda: False)
+    monkeypatch.setattr(basics, "rank", _not_init)
+    monkeypatch.setattr(basics, "size", _not_init)
+
+    # Each launcher/JAX signal alone must trip the guard (run.py:67-71).
+    for var, val in [("JAX_NUM_PROCESSES", "2"),
+                     ("JAX_COORDINATOR_ADDRESS", "127.0.0.1:9999"),
+                     ("HVD_TPU_COORDINATOR_HOST", "127.0.0.1")]:
+        for v in ("JAX_NUM_PROCESSES", "JAX_COORDINATOR_ADDRESS",
+                  "HVD_TPU_COORDINATOR_HOST"):
+            monkeypatch.delenv(v, raising=False)
+        monkeypatch.setenv(var, val)
+        assert checkpoint._multiprocess_env()
+        with pytest.raises(basics.NotInitializedError):
+            checkpoint.save(tmp_path / "race", {"w": jnp.zeros(2)})
+
+    # No signals: the single-process inference fallback still works.
+    for v in ("JAX_NUM_PROCESSES", "JAX_COORDINATOR_ADDRESS",
+              "HVD_TPU_COORDINATOR_HOST"):
+        monkeypatch.delenv(v, raising=False)
+    assert not checkpoint._multiprocess_env()
+    assert checkpoint._rank() == 0 and checkpoint._size() == 1
+
+    # Explicit -np 1: the launcher sets coordinator addresses even for a
+    # lone worker (run.py:67-71) and subprocesses inherit them — an
+    # authoritative JAX_NUM_PROCESSES=1 must keep the rank-0 fallback.
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "1")
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "127.0.0.1:9999")
+    monkeypatch.setenv("HVD_TPU_COORDINATOR_HOST", "127.0.0.1")
+    assert not checkpoint._multiprocess_env()
+    assert checkpoint._rank() == 0 and checkpoint._size() == 1
 
 
 def test_restore_without_init_single_chip(hvd, tmp_path):

@@ -64,6 +64,33 @@ def test_backward_padded_tokens_do_not_pollute_dscale(hvd):
                                rtol=2e-4, atol=2e-4)
 
 
+def test_block_autoscale_with_embed_dim(hvd):
+    """The token block shrinks as E grows so the backward working set stays
+    inside VMEM (advisor r4: fixed 512 spills at E≳4k), and an explicit
+    ``block`` overrides."""
+    from horovod_tpu.ops.rmsnorm import _block_tokens
+
+    assert _block_tokens(256) == 512       # small widths keep the max
+    assert _block_tokens(4096) < 512       # large widths scale down
+    assert _block_tokens(4096) * 4096 * 4 * 10 <= 12 * 1024 * 1024
+    assert _block_tokens(16384) >= 8       # floor holds
+    assert _block_tokens(4096, block=512) == 512  # escape hatch
+
+    # Numerics are block-size-independent: a wide-E input through the
+    # auto-scaled (smaller) block still matches the reference.
+    x = jax.random.normal(jax.random.PRNGKey(8), (96, 4096), jnp.float32)
+    scale = jnp.ones((4096,))
+    np.testing.assert_allclose(np.asarray(rms_norm(x, scale)),
+                               np.asarray(rms_norm_reference(x, scale)),
+                               rtol=1e-5, atol=1e-5)
+    gx, gs = jax.grad(_fused_loss, argnums=(0, 1))(x, scale)
+    gx_ref, gs_ref = jax.grad(_ref_loss, argnums=(0, 1))(x, scale)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(gx_ref),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(gs), np.asarray(gs_ref),
+                               rtol=2e-4, atol=2e-4)
+
+
 def test_leading_batch_dims(hvd):
     x = jax.random.normal(jax.random.PRNGKey(5), (4, 96, 256), jnp.float32)
     scale = jnp.ones((256,))
