@@ -20,7 +20,16 @@ Run under the launcher at increasing widths and compare:
     python -m horovod_tpu.run -np 4 -- \
         python examples/control_plane_benchmark.py
 
-Numbers recorded in docs/benchmarks.md (round 4) with the projected
+``--star P1,P2,...`` instead runs the ISOLATED star harness
+(core/src/star_bench.cc — the real TcpControlPlane::Gather/Broadcast on
+loopback threads, no JAX): one JSON line per width with the tick cost.
+This is the measurement behind the round-5 poll()-interleaved Gather and
+the 512-worker table in docs/benchmarks.md (the reference's demonstrated
+scale, reference README.md:45-51).
+
+    python examples/control_plane_benchmark.py --star 63,128,256,512
+
+Numbers recorded in docs/benchmarks.md (rounds 4-5) with the projected
 star ceiling.
 """
 
@@ -32,7 +41,23 @@ import time
 
 import numpy as np
 
-import horovod_tpu as hvd
+
+def run_star(widths: str, ticks: int, names: int) -> None:
+    """Build (if needed) and run the C++ star benchmark per width."""
+    import os
+    import subprocess
+
+    core = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "horovod_tpu", "core")
+    exe = os.path.join(core, "star_bench")
+    build = subprocess.run(["make", "-C", core, "star_bench"],
+                           capture_output=True, text=True)
+    if build.returncode != 0:
+        raise RuntimeError(f"star_bench build failed:\n{build.stderr}")
+    for p in widths.split(","):
+        out = subprocess.run([exe, p.strip(), str(ticks), str(names)],
+                             capture_output=True, text=True, check=True)
+        print(out.stdout.strip(), flush=True)
 
 
 def main() -> None:
@@ -41,7 +66,19 @@ def main() -> None:
     ap.add_argument("--burst", type=int, default=100,
                     help="outstanding async tensors per saturated round")
     ap.add_argument("--warmup", type=int, default=5)
+    ap.add_argument("--star", default=None,
+                    help="comma-separated widths for the isolated star "
+                    "harness (no JAX; e.g. 63,128,256,512)")
+    ap.add_argument("--star-ticks", type=int, default=200)
+    ap.add_argument("--star-names", type=int, default=1,
+                    help="negotiation names per worker frame")
     args = ap.parse_args()
+
+    if args.star:
+        run_star(args.star, args.star_ticks, args.star_names)
+        return
+
+    import horovod_tpu as hvd  # noqa: F811 — heavy import, star path skips it
 
     t0 = time.perf_counter()
     hvd.init()

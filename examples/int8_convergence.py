@@ -38,6 +38,9 @@ def main() -> None:
     ap.add_argument("--steps", type=int, default=80)
     ap.add_argument("--lr", type=float, default=2e-3)
     ap.add_argument("--record-every", type=int, default=10)
+    ap.add_argument("--layers", type=int, default=1, choices=(1, 2),
+                    help="hidden tanh layers; 2 = genuinely non-convex "
+                    "landscape (VERDICT r4 item 5)")
     args = ap.parse_args()
 
     if os.environ.get("_INT8_CONV_CHILD") != "1":
@@ -92,15 +95,29 @@ def main() -> None:
     y_all = (x_all @ w_true).argmax(1).astype(np.int32)
 
     def init_params():
-        k1, k2 = jax.random.split(jax.random.PRNGKey(0))
-        return {"w1": jax.random.normal(k1, (784, 64)) * 0.05,
-                "b1": jnp.zeros((64,)),
-                "w2": jax.random.normal(k2, (64, 10)) * 0.05,
-                "b2": jnp.zeros((10,))}
+        k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+        p = {"w1": jax.random.normal(k1, (784, 64)) * 0.05,
+             "b1": jnp.zeros((64,)),
+             "w2": jax.random.normal(k2, (64, 10)) * 0.05,
+             "b2": jnp.zeros((10,))}
+        if args.layers == 2:
+            # Two stacked tanh layers: composed nonlinearities make the
+            # loss genuinely non-convex in the parameters (a single
+            # hidden layer's landscape is benign enough that any
+            # roughly-unbiased wire noise washes out).
+            p["w2"] = jax.random.normal(k2, (64, 32)) * 0.05
+            p["b2"] = jnp.zeros((32,))
+            p["w3"] = jax.random.normal(k3, (32, 10)) * 0.05
+            p["b3"] = jnp.zeros((10,))
+        return p
 
     def loss_fn(p, x, y):
         h = jnp.tanh(x @ p["w1"] + p["b1"])
-        logits = h @ p["w2"] + p["b2"]
+        if args.layers == 2:
+            h = jnp.tanh(h @ p["w2"] + p["b2"])
+            logits = h @ p["w3"] + p["b3"]
+        else:
+            logits = h @ p["w2"] + p["b2"]
         return optax.softmax_cross_entropy_with_integer_labels(
             logits, y).mean()
 

@@ -75,6 +75,13 @@ def main():
                          "(measured ~3.4 MFU pts SLOWER than XLA's native "
                          "fusion at this geometry — docs/benchmarks.md; "
                          "default is the plain jnp path)")
+    ap.add_argument("--accumulate", type=int, default=1,
+                    help="gradient-accumulation microbatches per step "
+                         "(hvd.accumulate_gradients — the reference's "
+                         "backward_passes_per_step): raises tokens/step "
+                         "past the per-chip batch memory ceiling; "
+                         "--batch is the EFFECTIVE batch, activations "
+                         "peak at batch/accumulate")
     ap.add_argument("--bf16-params", action="store_true",
                     help="keep parameters resident in bf16 with f32 master "
                          "weights inside the optimizer state (kills the "
@@ -129,17 +136,27 @@ def main():
         def one(carry, _):
             params, opt_state = carry
 
-            def loss_fn(p):
-                logits = model.apply(p, tokens)
+            def loss_fn(p, toks):
+                logits = model.apply(p, toks)
                 # f32 softmax numerics with a logits-dtype cotangent
                 # (ops/losses.py).  Measured perf-neutral at this size —
                 # the CE chain overlaps with async DMA (profile notes in
                 # docs/benchmarks.md) — kept for the numerics-safe bf16
                 # cotangent contract.
                 return hvd.softmax_cross_entropy(
-                    logits[:, :-1], tokens[:, 1:]).mean()
+                    logits[:, :-1], toks[:, 1:]).mean()
 
-            loss, grads = jax.value_and_grad(loss_fn)(params)
+            if args.accumulate > 1:
+                # backward_passes_per_step: activations peak at the
+                # microbatch, one fused allreduce+update per step
+                # (training.accumulate_gradients; reference
+                # torch/__init__.py:62-112).
+                loss, grads = hvd.accumulate_gradients(
+                    lambda p, mb: jax.value_and_grad(loss_fn)(p, mb),
+                    params, tokens, args.accumulate)
+            else:
+                loss, grads = jax.value_and_grad(
+                    lambda p: loss_fn(p, tokens))(params)
             updates, opt_state = opt.update(grads, opt_state, params)
             return (optax.apply_updates(params, updates), opt_state), loss
 

@@ -51,7 +51,8 @@ for _mod, _names in {
         "allreduce_async", "allreduce_sparse", "alltoall", "alltoall_async",
         "barrier", "batch_spec", "broadcast", "broadcast_async",
         "flash_attention", "grouped_allreduce", "make_flash_attention",
-        "poll", "quantized_grouped_allreduce", "shard",
+        "overlap_compiler_options", "poll", "quantized_grouped_allreduce",
+        "shard",
         "softmax_cross_entropy", "sparse_to_dense", "synchronize",
     ),
     "horovod_tpu.training": (

@@ -247,12 +247,84 @@ bool TcpControlPlane::Exchange(const RequestList& send, ResponseList* recv) {
 
 bool TcpControlPlane::Gather(const RequestList& own,
                              std::vector<RequestList>* all) {
-  all->assign(worker_fds_.size() + 1, RequestList{});
+  // poll()-driven interleaved reads (round 5): the old loop recv'd
+  // workers sequentially in fd order, so at large P a tick cost the SUM
+  // of per-worker arrival latencies — measured past the 5 ms cycle
+  // budget somewhere above ~128 workers (docs/benchmarks.md
+  // control-plane scaling).  Draining whichever fd has bytes makes a
+  // tick cost max(worker latency) + P * frame-copy instead: the
+  // sequential-star analog of the reference's tree MPI_Gather
+  // (reference operations.cc:1742-1850) without a protocol change.
+  size_t n = worker_fds_.size();
+  all->assign(n + 1, RequestList{});
   (*all)[0] = own;
-  for (size_t i = 0; i < worker_fds_.size(); ++i) {
-    std::string in;
-    if (!RecvFrame(worker_fds_[i], &in)) return false;
-    if (!Deserialize(in.data(), in.size(), &(*all)[i + 1])) return false;
+  if (n == 0) return true;
+
+  struct FrameState {
+    uint32_t len = 0;        // payload length once the header is in
+    size_t got = 0;          // bytes of the current stage received
+    bool have_len = false;
+    bool done = false;
+    std::string buf;
+  };
+  std::vector<FrameState> st(n);
+  std::vector<pollfd> pfds(n);
+  std::vector<size_t> owner(n);  // pfds slot -> worker index
+  size_t remaining = n;
+  while (remaining > 0) {
+    nfds_t live = 0;
+    for (size_t i = 0; i < n; ++i) {
+      if (st[i].done) continue;
+      pfds[live].fd = worker_fds_[i];
+      pfds[live].events = POLLIN;
+      pfds[live].revents = 0;
+      owner[live] = i;
+      ++live;
+    }
+    int pr = ::poll(pfds.data(), live, -1);
+    if (pr < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    for (nfds_t s = 0; s < live; ++s) {
+      if ((pfds[s].revents & (POLLIN | POLLERR | POLLHUP)) == 0) continue;
+      size_t i = owner[s];
+      FrameState& f = st[i];
+      // Drain what is available without blocking; partial frames keep
+      // their state until the fd is readable again.
+      for (;;) {
+        ssize_t r;
+        if (!f.have_len) {
+          char* p = reinterpret_cast<char*>(&f.len);
+          r = ::recv(worker_fds_[i], p + f.got, 4 - f.got, MSG_DONTWAIT);
+        } else {
+          r = ::recv(worker_fds_[i], f.buf.data() + f.got,
+                     f.len - f.got, MSG_DONTWAIT);
+        }
+        if (r < 0) {
+          if (errno == EINTR) continue;
+          if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+          return false;
+        }
+        if (r == 0) return false;  // peer closed mid-frame
+        f.got += static_cast<size_t>(r);
+        if (!f.have_len) {
+          if (f.got < 4) continue;
+          if (f.len > (64u << 20)) return false;  // 64 MiB sanity cap
+          f.have_len = true;
+          f.got = 0;
+          f.buf.resize(f.len);
+          if (f.len > 0) continue;
+        } else if (f.got < f.len) {
+          continue;
+        }
+        if (!Deserialize(f.buf.data(), f.buf.size(), &(*all)[i + 1]))
+          return false;
+        f.done = true;
+        --remaining;
+        break;
+      }
+    }
   }
   return true;
 }

@@ -1,0 +1,120 @@
+// Isolated control-plane star benchmark (VERDICT r4 item 4).
+//
+// Measures the coordinator's REAL per-tick cost at width P on loopback —
+// the exact TcpControlPlane::Gather/Broadcast code the engine runs, with
+// no JAX or device work in the loop.  The reference's demonstrated scale
+// is 512 workers (reference README.md:45-51, MPI_Gather/Bcast control
+// plane); this harness answers whether the rank-0 TCP star's tick fits
+// the 5 ms HOROVOD_CYCLE_TIME budget there, and is the measurement
+// behind the poll()-interleaved Gather (controller.cc).
+//
+//   make -C horovod_tpu/core star_bench
+//   ./star_bench <P> <ticks> [payload_names]
+//
+// Output: one JSON line {p, ticks, tick_us, per_worker_us}.
+// Driven by examples/control_plane_benchmark.py --star.
+
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <unistd.h>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "controller.h"
+#include "message.h"
+
+namespace {
+
+hvd::RequestList MakeReq(int rank, int names) {
+  hvd::RequestList rl;
+  for (int i = 0; i < names; ++i) {
+    hvd::Request r;
+    r.rank = rank;
+    r.name = "grad/layer_" + std::to_string(i) + "/kernel";
+    r.shape.dims = {1024, 1024};
+    rl.requests.push_back(std::move(r));
+  }
+  return rl;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int p = argc > 1 ? std::atoi(argv[1]) : 64;
+  int ticks = argc > 2 ? std::atoi(argv[2]) : 200;
+  int names = argc > 3 ? std::atoi(argv[3]) : 1;
+  if (p < 2 || ticks < 2) {  // tick 0 is warmup; >=1 timed tick needed
+    std::fprintf(stderr, "usage: star_bench <P>=2.. <ticks>=2.. [names]\n");
+    return 2;
+  }
+
+  // MakeCoordinator blocks until all workers connect, so the worker
+  // threads must exist first: pick a port up front (workers retry
+  // connecting inside MakeWorker's rendezvous budget).
+  int port = 23000 + (::getpid() % 2000);
+
+  std::vector<std::thread> workers;
+  workers.reserve(static_cast<size_t>(p - 1));
+  for (int rank = 1; rank < p; ++rank) {
+    workers.emplace_back([rank, port, ticks, names]() {
+      std::string werr;
+      auto w = hvd::TcpControlPlane::MakeWorker("127.0.0.1", port, rank,
+                                                &werr);
+      if (!w) {
+        std::fprintf(stderr, "worker %d: %s\n", rank, werr.c_str());
+        std::exit(1);
+      }
+      hvd::RequestList req = MakeReq(rank, names);
+      hvd::ResponseList resp;
+      for (int t = 0; t < ticks; ++t) {
+        if (!w->Exchange(req, &resp)) {
+          std::fprintf(stderr, "worker %d: exchange failed\n", rank);
+          std::exit(1);
+        }
+      }
+    });
+  }
+
+  std::string err;
+  auto coord = hvd::TcpControlPlane::MakeCoordinator(port, p, &err);
+  if (!coord) {
+    std::fprintf(stderr, "coordinator: %s\n", err.c_str());
+    return 1;
+  }
+
+  hvd::RequestList own = MakeReq(0, names);
+  hvd::ResponseList verdict;  // a typical small verdict frame
+  {
+    hvd::Response r;
+    r.type = hvd::Response::Type::ALLREDUCE;
+    for (int i = 0; i < names; ++i)
+      r.tensor_names.push_back("grad/layer_" + std::to_string(i) +
+                               "/kernel");
+    verdict.responses.push_back(std::move(r));
+  }
+
+  std::vector<hvd::RequestList> all;
+  // Warmup tick: absorbs connect/first-allocation noise.
+  if (!coord->Gather(own, &all) || !coord->Broadcast(verdict)) {
+    std::fprintf(stderr, "coordinator tick failed\n");
+    return 1;
+  }
+  auto t0 = std::chrono::steady_clock::now();
+  for (int t = 1; t < ticks; ++t) {
+    if (!coord->Gather(own, &all) || !coord->Broadcast(verdict)) {
+      std::fprintf(stderr, "coordinator tick failed\n");
+      return 1;
+    }
+  }
+  auto t1 = std::chrono::steady_clock::now();
+  for (auto& w : workers) w.join();
+
+  double us = std::chrono::duration<double, std::micro>(t1 - t0).count() /
+              (ticks - 1);
+  std::printf("{\"p\": %d, \"ticks\": %d, \"tick_us\": %.1f, "
+              "\"per_worker_us\": %.2f}\n",
+              p, ticks, us, us / (p - 1));
+  return 0;
+}

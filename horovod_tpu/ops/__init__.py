@@ -6,6 +6,7 @@ from horovod_tpu.ops.collective_ops import (  # noqa: F401
     batch_spec,
     broadcast,
     grouped_allreduce,
+    overlap_compiler_options,
     quantized_grouped_allreduce,
     shard,
     sparse_to_dense,

@@ -327,15 +327,96 @@ def quantized_grouped_allreduce(tensors: Sequence, errors: Sequence | None = Non
     return reduced, resid
 
 
+def _chained_allreduce(vals: list, axes, n_buckets: int) -> list:
+    """Per-tensor psums in ``n_buckets`` dependency-chained groups, reverse
+    tree order (≈ backward availability: output-side layers' gradients
+    exist first).
+
+    Left alone, XLA's all-reduce combiner merges every gradient psum into
+    ONE tuple all-reduce that can only run after ALL of backward — zero
+    comm/compute overlap (the round-4 audit).  Chaining bucket ``i+1``'s
+    inputs on bucket ``i``'s output makes the bucket all-reduces
+    uncombinable (merging would form a cycle), so the backend schedules the
+    early buckets' reductions DURING the rest of backward — the property
+    the reference's whole hook-in-backward architecture exists for
+    (reference horovod/common/operations.cc:203-216,
+    horovod/torch/__init__.py:83-112).  With the async-collective-fusion
+    compiler options (:func:`overlap_compiler_options`) the v5e backend
+    additionally turns them into async continuation fusions (measured on
+    the real DistributedOptimizer step, deviceless v5e:2x4 AOT audit:
+    16 of 17 surviving all-reduces scheduled before the last backward
+    fusion at default flags; with the async options, 4 explicit
+    async-pair splits on top — examples/overlap_audit.py,
+    docs/benchmarks.md round 5).
+
+    The gate is ``where(isfinite(s), s, 0) * 0``: exactly 0.0 even for
+    inf/NaN gradients (no cross-bucket poisoning), yet data-dependent and
+    fold-proof (the compiler cannot prove the select's output finite —
+    plain ``s * 0`` would also work but ``optimization_barrier`` does NOT:
+    the TPU pipeline strips it before the combiner runs).  Non-float
+    leaves pass through ungated (the combiner may merge those; harmless).
+    """
+    n = len(vals)
+    bounds = np.linspace(0, n, n_buckets + 1).astype(int)
+    out: dict[int, jax.Array] = {}
+    gate = None
+    rev = list(range(n))[::-1]
+    for lo, hi in zip(bounds[:-1], bounds[1:]):
+        idx = rev[lo:hi]
+        if not idx:
+            continue
+        bucket = []
+        for i in idx:
+            v = vals[i]
+            if gate is not None and jnp.issubdtype(v.dtype, jnp.inexact):
+                v = v + gate.astype(v.dtype)
+            bucket.append(v)
+        red = [_mesh_allreduce(v, axes) for v in bucket]
+        # The gate sums a scalar from EVERY inexact reduction in the
+        # bucket, so the next bucket depends on all of them — merging any
+        # of this bucket's ARs forward would form a cycle structurally,
+        # not just for the first tensor.
+        scalars = [r.reshape(-1)[0].astype(jnp.float32) for r in red
+                   if jnp.issubdtype(r.dtype, jnp.inexact) and r.size > 0]
+        if scalars:
+            s = sum(scalars)
+            gate = jnp.where(jnp.isfinite(s), s, 0.0) * 0.0
+        for i, r in zip(idx, red):
+            out[i] = r
+    return [out[i] for i in range(n)]
+
+
+def overlap_compiler_options() -> dict:
+    """Compiler options that let the TPU backend EXECUTE the chained bucket
+    all-reduces asynchronously inside backward: pass to ``jax.jit(...,
+    compiler_options=hvd.overlap_compiler_options())`` on the train step.
+    Without them the chained buckets still schedule interleaved with
+    backward but run synchronously; with them the v5e backend emits
+    AsyncCollectiveStart continuation fusions (measured —
+    examples/overlap_audit.py).  Empty off-TPU (the options are
+    TPU-backend-specific and other compile paths reject unknown keys)."""
+    if jax.default_backend() != "tpu":
+        return {}
+    return {
+        "xla_enable_async_all_reduce": "true",
+        "xla_tpu_enable_async_collective_fusion": "true",
+        "xla_tpu_enable_async_collective_fusion_fuse_all_reduce": "true",
+    }
+
+
 def grouped_allreduce(tensors: Sequence, average: bool = True,
                       compression=Compression.none,
-                      threshold_bytes: int | None = None) -> list:
+                      threshold_bytes: int | None = None,
+                      overlap_buckets: int | None = None) -> list:
     """Fused allreduce of many tensors (reference fusion-buffer semantics,
     operations.cc:1807-1842).  In-mesh on a single axis: one psum per
-    tensor — XLA's all-reduce combiner does the batching, and
-    ``threshold_bytes`` is ignored (docs/tensor-fusion.md).  Hierarchical
-    (multi-axis) meshes, the eager path, and the int8 path in any
-    context: flat ``threshold_bytes``-bounded buckets (ops/fusion.py)."""
+    tensor in ``overlap_buckets`` dependency-chained groups (default
+    ``HOROVOD_OVERLAP_BUCKETS`` = 4; 0 restores the free-combining
+    structure whose psums XLA merges into one post-backward all-reduce —
+    see ``_chained_allreduce``), and ``threshold_bytes`` is ignored
+    (docs/tensor-fusion.md).  Hierarchical (multi-axis) meshes, the eager
+    path, and the int8 path in any context: flat ``threshold_bytes``-
+    bounded buckets (ops/fusion.py)."""
     if compression is Compression.int8:
         # Stateless quantized path (no error feedback): residuals dropped.
         reduced, _ = quantized_grouped_allreduce(
@@ -347,15 +428,20 @@ def grouped_allreduce(tensors: Sequence, average: bool = True,
         denom = _data_width(axes)
         if len(axes) == 1:
             # Single-axis compiled path: one psum per tensor — NO concat
-            # packing.  XLA's all-reduce combiner already merges adjacent
-            # psums into a single tuple-shaped AllReduce (measured on real
-            # v5e lowering: RotatedPincer ring emitter,
-            # examples/overlap_audit.py), so the reference-style flat
-            # fusion buffer duplicates the combiner's work and charges a
-            # pack+unpack pass over every gradient byte — removing it
-            # measured +2.5 MFU points on the 162M transformer
-            # (docs/benchmarks.md round 4).
-            reduced = [_mesh_allreduce(c, axes) for c, _ in comp]
+            # packing (a flat fusion buffer duplicates the backend's
+            # batching and charges a pack+unpack pass over every gradient
+            # byte — removing it measured +2.5 MFU points on the 162M
+            # transformer, docs/benchmarks.md round 4).  Psums are
+            # dependency-chained into buckets so they stay uncombined and
+            # overlap backward (round 5) — see _chained_allreduce.
+            from horovod_tpu.utils import env as _env
+
+            nb = (_env.overlap_buckets() if overlap_buckets is None
+                  else overlap_buckets)
+            if nb and nb > 1 and len(comp) > 1:
+                reduced = _chained_allreduce([c for c, _ in comp], axes, nb)
+            else:
+                reduced = [_mesh_allreduce(c, axes) for c, _ in comp]
         else:
             # Hierarchical (e.g. (dcn, ici)) route: each tensor lowers to
             # a psum_scatter→psum→all_gather CHAIN (parallel/hierarchy.py)

@@ -97,14 +97,21 @@ def _flash_kernel(meta_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref,
     hi, interior_end = _sub_bounds(meta_ref[2], q_min, q_max, ks_min,
                                    sub_k, nsub, causal)
 
-    q = q_ref[0].astype(jnp.float32) * scale              # [bq, D]
+    # The s matmul runs on INPUT-dtype operands: under JAX's default TPU
+    # matmul precision an f32×f32 dot already executes as a single bf16
+    # MXU pass (measured — the dtype of the operands does not change the
+    # MXU rate), so what the input-dtype form buys is skipping the
+    # per-tile k up-cast VPU pass.  The scale folds into q with one
+    # rounding to the input dtype (f32 inputs round-trip exactly, so
+    # tests stay bit-identical).
+    q = (q_ref[0].astype(jnp.float32) * scale).astype(q_ref.dtype)
 
     def body(si, carry, masked):
         m, l = carry
         k = k_ref[0, pl.ds(si * sub_k, sub_k), :]         # [sk, D]
         v = v_ref[0, pl.ds(si * sub_k, sub_k), :]
         s = jax.lax.dot_general(
-            q, k.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)           # [bq, sk]
         if masked:
             q_pos = (q_min + jax.lax.broadcasted_iota(
@@ -121,6 +128,11 @@ def _flash_kernel(meta_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref,
             p = jnp.where(mask, p, 0.0)
         corr = jnp.exp(m - m_new)
         l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        # p stays f32 for the PV matmul: rounding it to bf16 costs a VPU
+        # pass over the [bq, sub_k] tile that measured LARGER than any
+        # MXU saving (fwd 0.98→1.28 ms on the A/B) — under JAX's default
+        # TPU matmul precision the f32×(up-cast) v dot already executes
+        # as a single bf16 MXU pass with f32 accumulation.
         pv = jax.lax.dot_general(
             p, v.astype(jnp.float32), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -148,12 +160,25 @@ def _flash_kernel(meta_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref,
             _writeback(*body(0, (m_ref[0, 0, :][:, None],
                                  l_ref[0, 0, :][:, None]), masked=True))
     else:
-        carry = (m_ref[0, 0, :][:, None], l_ref[0, 0, :][:, None])
-        carry = jax.lax.fori_loop(
-            0, interior_end, functools.partial(body, masked=False), carry)
-        m, l = jax.lax.fori_loop(
-            interior_end, hi, functools.partial(body, masked=True), carry)
-        _writeback(m, l)
+        # Static UNROLL over sub-tiles (round 5, replacing the dynamic
+        # fori_loop): each sub-tile is a straight-line body under pl.when
+        # guards with the m/l carry staged through its VMEM refs, so
+        # Mosaic sees independent MXU matmuls (s_{i+1} depends only on
+        # q/k) it can schedule against the previous sub-tile's VPU
+        # softmax chain — the VPU work is ~2-3x the MXU time per tile
+        # and a dynamic-bound loop serialized them.
+        for si in range(nsub):
+            @pl.when(si < interior_end)
+            def _interior(si=si):
+                _writeback(*body(si, (m_ref[0, 0, :][:, None],
+                                      l_ref[0, 0, :][:, None]),
+                                 masked=False))
+
+            @pl.when(jnp.logical_and(si >= interior_end, si < hi))
+            def _boundary(si=si):
+                _writeback(*body(si, (m_ref[0, 0, :][:, None],
+                                      l_ref[0, 0, :][:, None]),
+                                 masked=True))
 
     @pl.when(ki == num_k_blocks - 1)
     def _finish():
@@ -292,14 +317,17 @@ def _bwd_dq_kernel(meta_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     hi, interior_end = _sub_bounds(meta_ref[2], q_min, q_max, ks_min,
                                    sub_k, nsub, causal)
 
-    q = q_ref[0].astype(jnp.float32) * scale              # [bq, D]
-    do = do_ref[0].astype(jnp.float32)                    # [bq, D]
+    # Input-dtype matmul operands with f32 accumulation — see
+    # _flash_kernel.  The scale-fold rounding matches the forward's, so
+    # s (hence p = exp(s − lse)) recomputes consistently.
+    q = (q_ref[0].astype(jnp.float32) * scale).astype(q_ref.dtype)
+    do = do_ref[0]                                        # [bq, D]
     lse = lse_ref[0, 0, :][:, None]                       # [bq, 1]
     delta = delta_ref[0, 0, :][:, None]
 
     def body(si, carry, masked):
-        k = k_ref[0, pl.ds(si * sub_k, sub_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(si * sub_k, sub_k), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(si * sub_k, sub_k), :]
+        v = v_ref[0, pl.ds(si * sub_k, sub_k), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if masked:
@@ -319,7 +347,7 @@ def _bwd_dq_kernel(meta_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
         dq_ref[0] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k_ref.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return carry
 
@@ -336,10 +364,17 @@ def _bwd_dq_kernel(meta_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         def _one_boundary():
             body(0, 0, masked=True)
     else:
-        jax.lax.fori_loop(0, interior_end,
-                          functools.partial(body, masked=False), 0)
-        jax.lax.fori_loop(interior_end, hi,
-                          functools.partial(body, masked=True), 0)
+        # Static unroll (see _flash_kernel): no carry here at all — the
+        # dq accumulator lives in its ref — so sub-tile bodies are fully
+        # independent for Mosaic's MXU/VPU scheduling.
+        for si in range(nsub):
+            @pl.when(si < interior_end)
+            def _interior(si=si):
+                body(si, 0, masked=False)
+
+            @pl.when(jnp.logical_and(si >= interior_end, si < hi))
+            def _boundary(si=si):
+                body(si, 0, masked=True)
 
     @pl.when(ki == num_k_blocks - 1)
     def _finish():
@@ -390,13 +425,16 @@ def _bwd_dkv_kernel(meta_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     int_start = jnp.where(k_valid, int_start, nsub)
     int_start = jnp.maximum(int_start, lo)
 
-    k = k_ref[0].astype(jnp.float32)                      # [bk, D]
-    v = v_ref[0].astype(jnp.float32)
+    k = k_ref[0]                                          # [bk, D]
+    v = v_ref[0]
 
     def body(si, carry, masked):
-        q = q_ref[0, pl.ds(si * sub_q, sub_q), :].astype(
-            jnp.float32) * scale                          # [sq, D]
-        do = do_ref[0, pl.ds(si * sub_q, sub_q), :].astype(jnp.float32)
+        # Same scale-fold rounding as the forward and dq kernels, so
+        # s (hence p = exp(s − lse)) recomputes consistently against the
+        # saved lse; k/v/do stay in the input dtype like everywhere else.
+        q = (q_ref[0, pl.ds(si * sub_q, sub_q), :].astype(jnp.float32)
+             * scale).astype(q_ref.dtype)                 # [sq, D]
+        do = do_ref[0, pl.ds(si * sub_q, sub_q), :]
         lse = lse_ref[0, 0, pl.ds(si * sub_q, sub_q)][:, None]
         delta = delta_ref[0, 0, pl.ds(si * sub_q, sub_q)][:, None]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -414,15 +452,17 @@ def _bwd_dkv_kernel(meta_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           jnp.exp(s - lse), 0.0)
         else:
             p = jnp.exp(s - lse)
+        # p stays f32 (mirroring the forward's PV choice); do up-casts for
+        # this one dot since lax.dot_general needs matching dtypes.
         dv_ref[0] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p, do.astype(jnp.float32), (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
         # q is pre-scaled, so this IS d s/d k contracted with ds.
         dk_ref[0] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q_ref.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return carry
 
@@ -439,10 +479,17 @@ def _bwd_dkv_kernel(meta_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         def _one_interior():
             body(0, 0, masked=False)
     else:
-        jax.lax.fori_loop(lo, int_start,
-                          functools.partial(body, masked=True), 0)
-        jax.lax.fori_loop(int_start, nsub,
-                          functools.partial(body, masked=False), 0)
+        # Static unroll (see _flash_kernel); dk/dv accumulate in refs so
+        # sub-tile bodies are independent.  Masked band first (lo <= si <
+        # int_start), mask-free tail (si >= int_start).
+        for si in range(nsub):
+            @pl.when(jnp.logical_and(si >= lo, si < int_start))
+            def _boundary(si=si):
+                body(si, 0, masked=True)
+
+            @pl.when(si >= int_start)
+            def _interior(si=si):
+                body(si, 0, masked=False)
 
 
 def flash_attention_backward(q, k, v, dout, lse, delta, causal,

@@ -8,12 +8,15 @@ This is the TPU-native analog of the reference's L4 surface:
   (reference tensorflow/__init__.py:135-225, torch/__init__.py:42-150,
   keras/_impl.py:20-61).  Compression and a backward-pass-style bucketing
   order are supported: buckets are issued as soon as their gradients exist
-  (the reference's backward-hook structure).  Measured caveat — current
-  XLA re-combines the bucket psums into one synchronous AllReduce after
-  backward, so there is no comm/compute overlap to credit on this
-  compiler version (examples/overlap_audit.py, tests/test_overlap.py;
-  docs/benchmarks.md appendix) — the scaling projection charges the full
-  serialized T_comm and still clears its target.
+  (the reference's backward-hook structure).  Round 5: the bucket psums
+  are dependency-chained so XLA's combiner cannot re-merge them, which
+  puts the early buckets' all-reduces INSIDE backward in the schedule;
+  with ``hvd.overlap_compiler_options()`` at jit time the TPU backend
+  executes them as async continuation fusions — real comm/compute
+  overlap, reproducing the reference's defining runtime property
+  (examples/overlap_audit.py, tests/test_overlap.py; docs/benchmarks.md).
+  The scaling projection still quotes its zero-overlap column as the
+  conservative floor.
 * ``broadcast_parameters`` / ``broadcast_optimizer_state`` — pytree-wide
   broadcast from a root worker, the state-bootstrap contract every reference
   binding ships (torch/__init__.py:153-301, tensorflow/__init__.py:90-133,
@@ -63,6 +66,7 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
                          compression=Compression.none,
                          threshold_bytes: int | None = None,
                          sharded_state: bool = False,
+                         overlap_buckets: int | None = None,
                          ) -> optax.GradientTransformation:
     """Wrap ``optimizer`` so updates see globally-averaged gradients.
 
@@ -84,13 +88,22 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
     becomes a reduce-scatter, the optimizer state lives sharded 1/K per
     device, and updates all-gather back (parallel/zero.py; in-mesh only,
     elementwise transforms).
+
+    ``overlap_buckets`` (default ``HOROVOD_OVERLAP_BUCKETS`` = 4; 0
+    disables) chains the single-axis bucket psums so the backend
+    schedules early buckets' all-reduces during backward — pass
+    ``compiler_options=hvd.overlap_compiler_options()`` to ``jax.jit`` to
+    make them asynchronous (collective_ops._chained_allreduce).
     """
     if sharded_state:
-        if compression is not Compression.none or threshold_bytes is not None:
+        if (compression is not Compression.none
+                or threshold_bytes is not None
+                or overlap_buckets is not None):
             raise ValueError(
                 "sharded_state=True uses a reduce-scatter of the flat "
-                "gradient vector; compression/threshold_bytes do not apply "
-                "to that path — drop them or use the replicated optimizer.")
+                "gradient vector; compression/threshold_bytes/"
+                "overlap_buckets do not apply to that path — drop them or "
+                "use the replicated optimizer.")
         from horovod_tpu.parallel.zero import zero_optimizer
 
         return zero_optimizer(optimizer, average=average)
@@ -126,7 +139,8 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
         leaves, treedef = jax.tree.flatten(grads)
         reduced = collective_ops.grouped_allreduce(
             leaves, average=average, compression=compression,
-            threshold_bytes=threshold_bytes)
+            threshold_bytes=threshold_bytes,
+            overlap_buckets=overlap_buckets)
         grads = jax.tree.unflatten(treedef, reduced)
         updates, inner = optimizer.update(grads, state.inner, params, **extra)
         return updates, DistributedState(inner=inner)

@@ -70,3 +70,20 @@ def stall_warning_seconds() -> float:
 def hierarchical_allreduce() -> bool:
     raw = _get("HIERARCHICAL_ALLREDUCE")
     return bool(raw) and raw not in ("0", "false", "False")
+
+
+DEFAULT_OVERLAP_BUCKETS = 4
+
+
+def overlap_buckets() -> int:
+    """Number of chained gradient buckets on the compiled single-axis
+    allreduce path (``HOROVOD_OVERLAP_BUCKETS`` / ``HVD_TPU_OVERLAP_BUCKETS``;
+    0 disables).  Chaining keeps the bucket all-reduces uncombinable so the
+    TPU backend can schedule the early ones DURING backward — the
+    comm/compute overlap the reference's hook architecture exists for
+    (reference horovod/common/operations.cc:203-216,
+    horovod/torch/__init__.py:83-112); pair with
+    ``hvd.overlap_compiler_options()`` at jit time for async execution
+    (ops/collective_ops.py:_chained_allreduce, examples/overlap_audit.py)."""
+    raw = _get("OVERLAP_BUCKETS")
+    return int(raw) if raw else DEFAULT_OVERLAP_BUCKETS

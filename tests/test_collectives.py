@@ -99,6 +99,56 @@ def test_grouped_allreduce_small_threshold(hvd):
         np.testing.assert_allclose(np.asarray(out)[3], expected, rtol=1e-5)
 
 
+def test_chained_allreduce_matches_uncained_and_isolates_nonfinite(hvd):
+    """The overlap chain (round 5, collective_ops._chained_allreduce) is
+    numerics-neutral: chained buckets produce the same sums as the
+    unchained structure, and a non-finite gradient in one bucket must NOT
+    leak into any other tensor (the gate is where(isfinite(s), s, 0)*0 —
+    exactly 0.0 even when the chained-on reduction is inf/NaN)."""
+    xs = [_per_chip_values(hvd, (8,), jnp.float32, seed=40 + i)
+          for i in range(6)]
+
+    def step_chain(*vs):
+        return tuple(hvd.grouped_allreduce(list(vs), average=False,
+                                           overlap_buckets=3))
+
+    def step_plain(*vs):
+        return tuple(hvd.grouped_allreduce(list(vs), average=False,
+                                           overlap_buckets=0))
+
+    specs = tuple(P("hvd") for _ in xs)
+    a = hvd.shard(step_chain, in_specs=specs, out_specs=specs)(*xs)
+    b = hvd.shard(step_plain, in_specs=specs, out_specs=specs)(*xs)
+    for ca, cb in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(ca), np.asarray(cb))
+
+    # An empty inexact leaf must not break the gate (it is skipped as a
+    # gate source — review r5: reshape(-1)[0] on size 0 raised at trace).
+    # Replicated spec: XLA pins zero-size arrays replicated regardless.
+    with_empty = xs + [jnp.zeros((0,), jnp.float32)]
+    specs7 = tuple(P("hvd") for _ in xs) + (P(),)
+
+    def step_empty(*vs):
+        return tuple(hvd.grouped_allreduce(list(vs), average=False,
+                                           overlap_buckets=3))
+
+    out7 = hvd.shard(step_empty, in_specs=specs7, out_specs=specs7)(
+        *with_empty)
+    assert out7[-1].shape == (0,)
+
+    # Poison the LAST leaf (reduced in the FIRST chained bucket — reverse
+    # order — so its result gates every later bucket): the other five
+    # tensors must come back finite and exact.
+    xs_bad = list(xs)
+    xs_bad[-1] = xs_bad[-1].at[0, 0].set(jnp.nan).at[1, 1].set(jnp.inf)
+    out = hvd.shard(step_chain, in_specs=specs, out_specs=specs)(*xs_bad)
+    for x, o in zip(xs[:-1], out[:-1]):
+        expected = np.sum(np.asarray(x), axis=0)
+        for r in range(hvd.num_chips()):
+            np.testing.assert_allclose(np.asarray(o)[r], expected, rtol=1e-5)
+    assert not np.isfinite(np.asarray(out[-1])).all()  # poison stayed put
+
+
 def test_grouped_allreduce_mixed_dtypes(hvd):
     """Dtype changes must break buckets (reference fuses same-dtype only)."""
     a = _per_chip_values(hvd, (4,), jnp.float32, seed=30)

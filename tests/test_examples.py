@@ -162,6 +162,17 @@ def test_torch_mnist_np2(tmp_path):
     assert vals[0] == vals[1], vals
 
 
+def test_torch_synthetic_benchmark_np2():
+    """The reference's north-star throughput harness
+    (pytorch_synthetic_benchmark.py protocol) runs under the launcher and
+    reports per-worker and total img/sec from rank 0."""
+    out = _run_np2("torch_synthetic_benchmark.py", "--model", "mlp",
+                   "--hidden", "64", "--num-warmup-batches", "2",
+                   "--num-batches-per-iter", "2", "--num-iters", "2")
+    assert re.search(r"Img/sec per worker: [\d.]+", out), out[-2000:]
+    assert re.search(r"Total img/sec on 2 worker\(s\)", out), out[-2000:]
+
+
 def test_tensorflow_mnist_np2():
     out = _run_np2("tensorflow_mnist.py", "--epochs", "1",
                    "--batch-size", "32")

@@ -279,3 +279,59 @@ def test_master_weights_composes_with_int8_ef(hvd):
     # itself is quantized one level further; documented trade).
     assert jax.tree.leaves(s2.error)[0].dtype == jnp.bfloat16
     assert float(l2) < float(l1)
+
+
+def test_accumulate_composes_with_master_weights_and_int8_ef(hvd):
+    """The 468M-row recipe (VERDICT r4 item 3) as one pinned composition:
+    hvd.accumulate_gradients microbatching feeding
+    DistributedOptimizer(master_weights(adamw), compression=int8).  The
+    accumulated-microbatch step must (a) keep all three state layers
+    (bf16 resident params, f32 master, EF residuals), (b) make progress,
+    and (c) match the full-batch step's update to quantization-free
+    equality — accumulation happens BEFORE the wire, so the int8
+    quantizer sees identical averaged gradients either way."""
+    params = {"w": jnp.ones((64, 32), jnp.bfloat16) * 0.5}
+    opt = hvd.DistributedOptimizer(hvd.master_weights(optax.adamw(1e-2)),
+                                   compression=hvd.Compression.int8)
+    state = opt.init(params)
+
+    def make_step(n_micro):
+        @hvd.shard(in_specs=(P(), P(), hvd.batch_spec(2)),
+                   out_specs=(P(), P(), P()))
+        def step(params, state, x):
+            def loss(p, xb):
+                return jnp.mean((xb.astype(jnp.bfloat16) @ p["w"]).astype(
+                    jnp.float32) ** 2)
+
+            if n_micro > 1:
+                l, g = hvd.accumulate_gradients(
+                    lambda p, xb: jax.value_and_grad(loss)(p, xb),
+                    params, x, n_micro)
+            else:
+                l, g = jax.value_and_grad(lambda p: loss(p, x))(params)
+            u, state2 = opt.update(g, state, params)
+            return optax.apply_updates(params, u), state2, l
+
+        return step
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (4 * hvd.num_chips(), 64))
+    p_full, s_full, l_full = make_step(1)(params, state, x)
+    p_acc, s_acc, l_acc = make_step(2)(params, state, x)
+    assert p_acc["w"].dtype == jnp.bfloat16
+    assert s_acc.inner.master["w"].dtype == jnp.float32
+    assert jax.tree.leaves(s_acc.error)[0].dtype == jnp.bfloat16
+    # Mean-reduced loss ⇒ microbatch accumulation reproduces the
+    # full-batch gradients up to bf16 tolerance: XLA lowers the (B, K)
+    # and (B/2, K) bf16 matmuls with different internal precision, so
+    # per-row products differ at bf16 epsilon (measured ~7e-4 relative on
+    # the loss) — the agreement pinned here is bf16-level, not bitwise.
+    np.testing.assert_allclose(float(l_acc), float(l_full), rtol=5e-3)
+    np.testing.assert_allclose(
+        np.asarray(p_acc["w"], np.float32),
+        np.asarray(p_full["w"], np.float32), rtol=1e-2, atol=1e-3)
+    np.testing.assert_allclose(
+        np.asarray(s_acc.inner.master["w"]),
+        np.asarray(s_full.inner.master["w"]), rtol=1e-2, atol=1e-3)
+    # And training continues to make progress from the accumulated state.
+    _, _, l_next = make_step(2)(p_acc, s_acc, x)
+    assert float(l_next) < float(l_acc)
