@@ -172,16 +172,15 @@ def main():
     if platform == "cpu":
         results["cpu_sim"] = audit_cpu_sim()
     else:
-        from horovod_tpu.ops.collective_ops import overlap_compiler_options
+        # The constant, not overlap_compiler_options(): the deviceless AOT
+        # compile targets TPU regardless of this host's default backend,
+        # and the audit must always measure the SHIPPED flag set.
+        from horovod_tpu.ops.collective_ops import OVERLAP_XLA_OPTIONS
 
         try:
             results["tpu_topology"] = audit_tpu_topology()
             results["tpu_topology_async"] = audit_tpu_topology(
-                compiler_options=overlap_compiler_options()
-                or {"xla_enable_async_all_reduce": "true",
-                    "xla_tpu_enable_async_collective_fusion": "true",
-                    "xla_tpu_enable_async_collective_fusion_fuse_all_reduce":
-                        "true"})
+                compiler_options=dict(OVERLAP_XLA_OPTIONS))
         except Exception as e:  # topology compile unsupported here
             results["tpu_topology_error"] = f"{type(e).__name__}: {e}"
         results["cpu_sim"] = "run under JAX_PLATFORMS=cpu for the sim audit"

@@ -81,7 +81,9 @@ int main(int argc, char** argv) {
   auto coord = hvd::TcpControlPlane::MakeCoordinator(port, p, &err);
   if (!coord) {
     std::fprintf(stderr, "coordinator: %s\n", err.c_str());
-    return 1;
+    // exit(), not return: worker threads are joinable, and destroying
+    // them would std::terminate with a core dump instead of this message.
+    std::exit(1);
   }
 
   hvd::RequestList own = MakeReq(0, names);
@@ -99,13 +101,13 @@ int main(int argc, char** argv) {
   // Warmup tick: absorbs connect/first-allocation noise.
   if (!coord->Gather(own, &all) || !coord->Broadcast(verdict)) {
     std::fprintf(stderr, "coordinator tick failed\n");
-    return 1;
+    std::exit(1);  // see the bind-failure note: joinable threads live
   }
   auto t0 = std::chrono::steady_clock::now();
   for (int t = 1; t < ticks; ++t) {
     if (!coord->Gather(own, &all) || !coord->Broadcast(verdict)) {
       std::fprintf(stderr, "coordinator tick failed\n");
-      return 1;
+      std::exit(1);  // see the bind-failure note
     }
   }
   auto t1 = std::chrono::steady_clock::now();

@@ -355,6 +355,13 @@ def _chained_allreduce(vals: list, axes, n_buckets: int) -> list:
     plain ``s * 0`` would also work but ``optimization_barrier`` does NOT:
     the TPU pipeline strips it before the combiner runs).  Non-float
     leaves pass through ungated (the combiner may merge those; harmless).
+
+    Memory trade: pulling the reductions into backward extends gradient
+    live ranges, raising peak HBM by up to a few hundred MB on large
+    models (measured: 468M/B=16 OOMs by 79 MB with the default chain and
+    fits with ``HOROVOD_OVERLAP_BUCKETS=0`` — docs/benchmarks.md round
+    5).  Within ~1 GB of the HBM ceiling, disable the chain first
+    (docs/troubleshooting.md OOM entry).
     """
     n = len(vals)
     bounds = np.linspace(0, n, n_buckets + 1).astype(int)
@@ -386,6 +393,18 @@ def _chained_allreduce(vals: list, axes, n_buckets: int) -> list:
     return [out[i] for i in range(n)]
 
 
+# The load-bearing flag set for async bucket all-reduces (measured on the
+# v5e:2x4 AOT audit — docs/benchmarks.md round 5).  One source of truth:
+# overlap_compiler_options() serves runtime callers, and the deviceless
+# AOT audit (examples/overlap_audit.py) imports this constant directly so
+# its recorded numbers always describe the shipped flags.
+OVERLAP_XLA_OPTIONS = {
+    "xla_enable_async_all_reduce": "true",
+    "xla_tpu_enable_async_collective_fusion": "true",
+    "xla_tpu_enable_async_collective_fusion_fuse_all_reduce": "true",
+}
+
+
 def overlap_compiler_options() -> dict:
     """Compiler options that let the TPU backend EXECUTE the chained bucket
     all-reduces asynchronously inside backward: pass to ``jax.jit(...,
@@ -397,11 +416,7 @@ def overlap_compiler_options() -> dict:
     TPU-backend-specific and other compile paths reject unknown keys)."""
     if jax.default_backend() != "tpu":
         return {}
-    return {
-        "xla_enable_async_all_reduce": "true",
-        "xla_tpu_enable_async_collective_fusion": "true",
-        "xla_tpu_enable_async_collective_fusion_fuse_all_reduce": "true",
-    }
+    return dict(OVERLAP_XLA_OPTIONS)
 
 
 def grouped_allreduce(tensors: Sequence, average: bool = True,

@@ -38,6 +38,12 @@ except ImportError:  # pragma: no cover
     _SMEM = None
 
 NEG_INF = -1e30
+LOG2E = 1.4426950408889634  # log2(e): folded into the q scale so the
+# online softmax runs on exp2 — the VPU's native exponential — instead
+# of exp (which lowers to a multiply + exp2 per element).  ln2 factors
+# re-enter only at block boundaries (lse output, dk finish), never on
+# the hot [bq, sub_k] tiles.
+LN2 = 0.6931471805599453
 
 
 def _sub_bounds(k_len, q_min, q_max, ks_min, sub_k, nsub, causal):
@@ -101,10 +107,11 @@ def _flash_kernel(meta_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref,
     # matmul precision an f32×f32 dot already executes as a single bf16
     # MXU pass (measured — the dtype of the operands does not change the
     # MXU rate), so what the input-dtype form buys is skipping the
-    # per-tile k up-cast VPU pass.  The scale folds into q with one
-    # rounding to the input dtype (f32 inputs round-trip exactly, so
-    # tests stay bit-identical).
-    q = (q_ref[0].astype(jnp.float32) * scale).astype(q_ref.dtype)
+    # per-tile k up-cast VPU pass.  The scale folds into q (together
+    # with log2(e) — scores live in the log2 domain so the hot
+    # exponentials are exp2, see LOG2E) with one rounding to the input
+    # dtype (f32 inputs round-trip exactly).
+    q = (q_ref[0].astype(jnp.float32) * (scale * LOG2E)).astype(q_ref.dtype)
 
     def body(si, carry, masked):
         m, l = carry
@@ -123,10 +130,10 @@ def _flash_kernel(meta_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref,
                 mask = jnp.logical_and(mask, q_pos >= k_pos)
             s = jnp.where(mask, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
+        p = jnp.exp2(s - m_new)
         if masked:
             p = jnp.where(mask, p, 0.0)
-        corr = jnp.exp(m - m_new)
+        corr = jnp.exp2(m - m_new)
         l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
         # p stays f32 for the PV matmul: rounding it to bf16 costs a VPU
         # pass over the [bq, sub_k] tile that measured LARGER than any
@@ -187,10 +194,14 @@ def _flash_kernel(meta_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref,
         o_ref[0] = o_ref[0] / jnp.maximum(l, 1e-30)
         # log-sum-exp per query row (NEG_INF where a row attended to
         # nothing) — lets callers combine partial attentions exactly
-        # (ring attention).  Stored sublane-replicated (8, block_q):
-        # Mosaic requires the last two block dims be (8k, 128k)-tileable,
-        # which a (1, block_q) row is not.
-        lse = jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-30)), NEG_INF)
+        # (ring attention).  m carries log2-domain scores (LOG2E fold),
+        # so the NATURAL-log contract converts here: lse = m·ln2 +
+        # log(l) — a per-row op at block end, off the hot tiles.
+        # Stored sublane-replicated (8, block_q): Mosaic requires the
+        # last two block dims be (8k, 128k)-tileable, which a
+        # (1, block_q) row is not.
+        lse = jnp.where(l > 0, m * LN2 + jnp.log(jnp.maximum(l, 1e-30)),
+                        NEG_INF)
         lse_ref[0] = jnp.broadcast_to(lse[:, 0][None, :], lse_ref.shape[1:])
 
 
@@ -318,11 +329,14 @@ def _bwd_dq_kernel(meta_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                                    sub_k, nsub, causal)
 
     # Input-dtype matmul operands with f32 accumulation — see
-    # _flash_kernel.  The scale-fold rounding matches the forward's, so
-    # s (hence p = exp(s − lse)) recomputes consistently.
-    q = (q_ref[0].astype(jnp.float32) * scale).astype(q_ref.dtype)
+    # _flash_kernel.  The scale-fold rounding (incl. the LOG2E factor)
+    # matches the forward's, so s — hence p = exp2(s − lse·log2e) —
+    # recomputes consistently; the saved lse arrives in natural units
+    # (the public ring-attention contract) and converts per block row.
+    q = (q_ref[0].astype(jnp.float32) * (scale * LOG2E)).astype(q_ref.dtype)
     do = do_ref[0]                                        # [bq, D]
-    lse = lse_ref[0, 0, :][:, None]                       # [bq, 1]
+    lse = lse_ref[0, 0, :][:, None]                       # [bq, 1] natural
+    lse2 = lse * LOG2E                                    # log2 domain
     delta = delta_ref[0, 0, :][:, None]
 
     def body(si, carry, masked):
@@ -340,9 +354,9 @@ def _bwd_dq_kernel(meta_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             if causal:
                 mask = jnp.logical_and(mask, q_pos >= k_pos)
             p = jnp.where(jnp.logical_and(mask, row_ok),
-                          jnp.exp(s - lse), 0.0)
+                          jnp.exp2(s - lse2), 0.0)
         else:
-            p = jnp.exp(s - lse)
+            p = jnp.exp2(s - lse2)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
@@ -429,13 +443,16 @@ def _bwd_dkv_kernel(meta_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     v = v_ref[0]
 
     def body(si, carry, masked):
-        # Same scale-fold rounding as the forward and dq kernels, so
-        # s (hence p = exp(s − lse)) recomputes consistently against the
-        # saved lse; k/v/do stay in the input dtype like everywhere else.
+        # Same scale-fold rounding (incl. LOG2E) as the forward and dq
+        # kernels, so s — hence p = exp2(s − lse·log2e) — recomputes
+        # consistently; k/v/do stay in the input dtype like everywhere
+        # else.  The fold's log2e surplus on dk is repaid by the ·ln2 in
+        # _finish (dv uses p directly and needs none).
         q = (q_ref[0, pl.ds(si * sub_q, sub_q), :].astype(jnp.float32)
-             * scale).astype(q_ref.dtype)                 # [sq, D]
+             * (scale * LOG2E)).astype(q_ref.dtype)       # [sq, D]
         do = do_ref[0, pl.ds(si * sub_q, sub_q), :]
-        lse = lse_ref[0, 0, pl.ds(si * sub_q, sub_q)][:, None]
+        lse = lse_ref[0, 0, pl.ds(si * sub_q, sub_q)][:, None]  # natural
+        lse2 = lse * LOG2E
         delta = delta_ref[0, 0, pl.ds(si * sub_q, sub_q)][:, None]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
@@ -449,9 +466,9 @@ def _bwd_dkv_kernel(meta_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             if causal:
                 mask = jnp.logical_and(mask, q_pos >= k_pos)
             p = jnp.where(jnp.logical_and(mask, row_ok),
-                          jnp.exp(s - lse), 0.0)
+                          jnp.exp2(s - lse2), 0.0)
         else:
-            p = jnp.exp(s - lse)
+            p = jnp.exp2(s - lse2)
         # p stays f32 (mirroring the forward's PV choice); do up-casts for
         # this one dot since lax.dot_general needs matching dtypes.
         dv_ref[0] += jax.lax.dot_general(
@@ -460,7 +477,8 @@ def _bwd_dkv_kernel(meta_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
-        # q is pre-scaled, so this IS d s/d k contracted with ds.
+        # q is pre-scaled (incl. LOG2E), so this is d s/d k contracted
+        # with ds up to the log2e surplus repaid in _finish.
         dk_ref[0] += jax.lax.dot_general(
             ds.astype(q_ref.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -490,6 +508,12 @@ def _bwd_dkv_kernel(meta_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             @pl.when(si >= int_start)
             def _interior(si=si):
                 body(si, 0, masked=False)
+
+    @pl.when(qi == num_q_blocks - 1)
+    def _finish():
+        # The q fold carried scale·log2e; dk needs plain scale — repay
+        # the log2e once per resident block (log2e·ln2 == 1).
+        dk_ref[0] = dk_ref[0] * LN2
 
 
 def flash_attention_backward(q, k, v, dout, lse, delta, causal,

@@ -96,9 +96,11 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
     make them asynchronous (collective_ops._chained_allreduce).
     """
     if sharded_state:
+        # overlap_buckets=0 means "disabled" and is compatible (a user
+        # mirroring HOROVOD_OVERLAP_BUCKETS=0 into code must not error).
         if (compression is not Compression.none
                 or threshold_bytes is not None
-                or overlap_buckets is not None):
+                or overlap_buckets not in (None, 0)):
             raise ValueError(
                 "sharded_state=True uses a reduce-scatter of the flat "
                 "gradient vector; compression/threshold_bytes/"
