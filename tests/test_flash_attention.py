@@ -63,6 +63,20 @@ def test_bf16(hvd):
                                ref.astype(np.float32), atol=3e-2, rtol=3e-2)
 
 
+def test_default_block_k(hvd):
+    """block_k=None resolves to min(S, 2048) at d≤128 — the largest
+    streaming tile that compiles on every shipped long-context config
+    (4096 VMEM-overflows the S=32768 remat backward) — and stays at the
+    proven 1024 for d>128 where K/V tile bytes scale with d."""
+    from horovod_tpu.ops.flash_attention import _default_block_k
+
+    assert _default_block_k(1024, 128) == 1024   # clamps to S
+    assert _default_block_k(8192, 128) == 2048   # the measured default
+    assert _default_block_k(32768, 128) == 2048  # capped (VMEM)
+    assert _default_block_k(8192, 256) == 1024   # d>128 safety branch
+    assert _default_block_k(0, 128) == 1         # degenerate floor
+
+
 @pytest.mark.parametrize("s", [64, 50])
 def test_subtiled_kernels_match_dense(hvd, s):
     """nsub > 1 (sub < block): the statically-unrolled sub-tile loop
