@@ -33,6 +33,7 @@ def main() -> None:
     from jax.sharding import PartitionSpec as P
 
     import horovod_tpu as hvd
+    from horovod_tpu import faults
     from horovod_tpu.models import ResNet50
 
     hvd.init()
@@ -91,8 +92,16 @@ def main() -> None:
         out_specs=(P(), P(), P(), P())),
         donate_argnums=(0, 1, 2))
 
+    bench_step = 0
+
     def run_one():
-        nonlocal params, batch_stats, opt_state
+        nonlocal params, batch_stats, opt_state, bench_step
+        # Fault-injection clock (faults.py): HVD_TPU_FAULT_* scenarios —
+        # kill/stall/delay this rank at a given dispatch — replay
+        # deterministically against the benchmark, so robustness drills use
+        # the same harness as the throughput numbers.  Free when disarmed.
+        faults.step(bench_step)
+        bench_step += 1
         params, batch_stats, opt_state, loss = step(
             params, batch_stats, opt_state, x, y)
         return loss

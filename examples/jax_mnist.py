@@ -20,6 +20,7 @@ import optax
 from jax.sharding import PartitionSpec as P
 
 import horovod_tpu as hvd
+from horovod_tpu import faults
 from horovod_tpu.models import MnistCNN
 
 
@@ -93,18 +94,50 @@ def main():
         preds = jnp.argmax(model.apply(params, x), axis=-1)
         return hvd.allreduce(jnp.sum(preds == y), average=False)
 
+    # Elastic supervision (docs/fault_tolerance.md): epoch-granular
+    # checkpoints through the manifest-committed CheckpointManager, resume
+    # from the newest complete one (the launcher's --max-restarts path
+    # exports HVD_TPU_RESUME_DIR but the manager re-scans the same root),
+    # a SIGTERM drain that saves before exiting, and the fault-injection
+    # clock so HVD_TPU_FAULT_* scenarios replay deterministically.
+    manager = hvd.checkpoint.CheckpointManager(args.ckpt_dir)
+    hvd.checkpoint.install_preemption_handler()
+    start_epoch, gstep = 0, 0
+    ckpt = manager.restore_latest(
+        template={"params": params, "opt_state": opt_state})
+    if ckpt is not None:
+        params, opt_state = ckpt.state["params"], ckpt.state["opt_state"]
+        start_epoch = int(ckpt.metadata.get("completed_epoch", -1)) + 1
+        gstep = ckpt.step + 1
+        if hvd.rank() == 0:
+            print(f"resumed from epoch {start_epoch - 1}", flush=True)
+
     # Host loading runs on a background thread and the next batch's
     # host-to-device transfer overlaps the current step (the overlap the
     # reference got from DataLoader workers + CUDA streams).  On a real
     # TPU run pass sharding=(hvd.data_sharding(4), hvd.data_sharding(1))
     # to land batches pre-sharded (safe everywhere: on the CPU simulation
     # backend sharded puts complete synchronously — prefetch_to_device).
-    for epoch in range(args.epochs):
+    loss, acc = None, float("nan")
+    for epoch in range(start_epoch, args.epochs):
         t0 = time.time()
         loss = None
         for xb, yb in hvd.data.prefetch_to_device(
                 hvd.data.BackgroundLoader(batches)):
+            faults.step(gstep)
+            if hvd.checkpoint.preemption_requested():
+                # Drain: one complete checkpoint, then a clean exit the
+                # launcher recognizes (epoch-granular resume — the
+                # in-progress epoch is repeated).
+                manager.save(gstep, {"params": params,
+                                     "opt_state": opt_state},
+                             metadata={"completed_epoch": epoch - 1})
+                manager.drain()
+                raise SystemExit(0)
             params, opt_state, loss = train_step(params, opt_state, xb, yb)
+            gstep += 1
+        manager.save(gstep, {"params": params, "opt_state": opt_state},
+                     metadata={"completed_epoch": epoch})
         correct = sum(
             int(eval_correct(params, jnp.asarray(xb), jnp.asarray(yb)))
             for xb, yb in batches)
@@ -120,9 +153,9 @@ def main():
     print(f"[rank {hvd.rank()}/{hvd.size()}] final loss={final_loss:.6f} "
           f"acc={acc:.4f}", flush=True)
 
-    # Horovod: checkpoint on rank 0 only (reference :108-110).
-    hvd.checkpoint.save_epoch(args.ckpt_dir, args.epochs - 1,
-                              {"params": params})
+    # Horovod: checkpoint on rank 0 only (reference :108-110); the manager
+    # already committed the final epoch above.
+    manager.drain()
     if hvd.rank() == 0:
         print("done; checkpoint written to", args.ckpt_dir)
 

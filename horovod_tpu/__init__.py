@@ -39,7 +39,8 @@ for _mod, _names in {
         "NotInitializedError", "chips_per_slice", "cross_rank", "cross_size",
         "init", "is_initialized", "local_num_chips", "local_rank",
         "local_size", "member_process_ids", "mpi_threads_supported",
-        "num_chips", "rank", "shutdown", "size", "subset_active",
+        "num_chips", "rank", "shutdown", "size", "stall_report",
+        "subset_active",
     ),
     "horovod_tpu.core.engine": ("CollectiveError",),
     "horovod_tpu.mesh": (
@@ -58,7 +59,8 @@ for _mod, _names in {
     "horovod_tpu.training": (
         "DistributedOptimizer", "accumulate_gradients", "allgather_object",
         "broadcast_object", "broadcast_optimizer_state",
-        "broadcast_parameters", "master_weights", "scale_learning_rate",
+        "broadcast_parameters", "elastic_loop", "master_weights",
+        "scale_learning_rate",
     ),
 }.items():
     for _n in _names:
@@ -69,9 +71,9 @@ del _mod, _names, _n
 _MODULE_ATTRS = {"profiling": "horovod_tpu.utils.profiling"}
 
 _SUBMODULES = frozenset({
-    "basics", "callbacks", "checkpoint", "core", "data", "flax", "keras",
-    "mesh", "models", "ops", "parallel", "run", "tensorflow", "torch",
-    "training", "utils",
+    "basics", "callbacks", "checkpoint", "core", "data", "faults", "flax",
+    "keras", "mesh", "models", "ops", "parallel", "run", "tensorflow",
+    "torch", "training", "utils",
 })
 
 # NOTE: __all__ deliberately excludes the lazy submodules — a star-import

@@ -127,6 +127,12 @@ def init(*, distributed: bool | None = None, coordinator_address: str | None = N
     global _topology
     import jax
 
+    from horovod_tpu.utils import jaxcompat
+
+    # Tests and user code reach jax.shard_map directly after init();
+    # bridge the pinned-release surface first (utils/jaxcompat.py).
+    jaxcompat.install()
+
     if comm is not None:
         if ranks is not None:
             raise ValueError("pass either ranks= or comm=, not both")
@@ -160,6 +166,11 @@ def init(*, distributed: bool | None = None, coordinator_address: str | None = N
         if want_dist is None:
             want_dist = coordinator_address is not None
         if want_dist:
+            if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+                # Multi-process CPU jobs (the launcher's -np N simulation)
+                # need an explicit CPU-collectives backend on the pinned
+                # jaxlib (utils/jaxcompat.py).
+                jaxcompat.enable_cpu_multiprocess_collectives()
             try:
                 jax.distributed.initialize(
                     coordinator_address=coordinator_address,
@@ -304,6 +315,22 @@ def subset_active() -> bool:
     import jax
 
     return len(t.member_pids) != jax.process_count()
+
+
+def stall_report() -> list:
+    """Structured stall report from the eager control plane's coordinator:
+    ``[(tensor_name, [missing ranks]), ...]`` for every collective stuck
+    past the stall-warning window (``HOROVOD_STALL_WARNING_TIME``).
+
+    The reference logs this condition as an unparseable WARNING string
+    (CheckForStalledTensors, operations.cc:1366-1412); here monitoring/
+    test code reads it programmatically.  Empty off the coordinator, when
+    nothing is stalled, or when the eager engine was never started (the
+    compiled SPMD path cannot stall asymmetrically — XLA lockstep)."""
+    _topo()
+    from horovod_tpu.core import engine as _engine
+
+    return _engine.stall_report()
 
 
 def mpi_threads_supported() -> bool:

@@ -16,13 +16,17 @@ from __future__ import annotations
 
 import atexit
 import os
+import shutil
+import signal as _signal
 import threading
-from typing import Any
+import warnings
+from typing import Any, NamedTuple
 
 import jax
 import numpy as np
 
-from horovod_tpu import basics, training
+from horovod_tpu import basics, faults, training
+from horovod_tpu.utils import manifest
 
 
 def _multiprocess_env() -> bool:
@@ -183,6 +187,12 @@ def save(path: str | os.PathLike, state: Any, *, force: bool = True,
                 f"arrays; got a cross-process sharded array "
                 f"{v.shape} ({v.sharding}) — all-gather it before save() "
                 f"or checkpoint per-shard with your own orbax setup")
+        if isinstance(v, jax.Array) and jax.process_count() > 1:
+            # Fully-addressable device array in a multi-process job: orbax
+            # classifies it "host-local" and refuses to serialize through
+            # the lone-process checkpointer — land it on host (the rank-0
+            # writer's copy IS the checkpoint under the contract above).
+            return np.asarray(v)
         return v
 
     state = jax.tree.map(_to_host, state)
@@ -370,3 +380,250 @@ def restore_epoch(path: str | os.PathLike, epoch: int,
                   template: Any | None = None, **kw) -> Any:
     return restore(os.path.join(os.fspath(path), f"epoch_{epoch}"),
                    template, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Preemption handling + the elastic CheckpointManager
+# ---------------------------------------------------------------------------
+
+_preempt_event = threading.Event()
+_prev_handlers: dict[int, Any] = {}
+_handler_lock = threading.Lock()
+
+
+def _on_preempt_signal(signum, frame):
+    """Signal handler: ONLY set the flag (async-safe); the training loop
+    observes it at the next step boundary and drains a checkpoint.  Any
+    previously-installed Python handler is chained so user hooks keep
+    firing."""
+    _preempt_event.set()
+    prev = _prev_handlers.get(signum)
+    if callable(prev):
+        prev(signum, frame)
+
+
+def install_preemption_handler(
+        signals: tuple[int, ...] = (_signal.SIGTERM, _signal.SIGINT)) -> None:
+    """Arm the checkpoint-now flag on preemption signals.
+
+    TPU VM preemptions deliver SIGTERM with a short grace window
+    (docs/fault_tolerance.md); the launcher's drain path forwards the
+    same signal to every rank's process group.  Idempotent; only the
+    main thread may install (CPython restriction)."""
+    with _handler_lock:
+        for signum in signals:
+            if signum in _prev_handlers:
+                continue
+            prev = _signal.signal(signum, _on_preempt_signal)
+            _prev_handlers[signum] = (
+                prev if prev not in (_signal.SIG_DFL, _signal.SIG_IGN,
+                                     _signal.default_int_handler) else None)
+
+
+def preemption_requested() -> bool:
+    """True once a preemption signal (or :func:`request_checkpoint`) fired."""
+    return _preempt_event.is_set()
+
+
+def request_checkpoint() -> None:
+    """Programmatically raise the checkpoint-now flag (tests, schedulers)."""
+    _preempt_event.set()
+
+
+def clear_preemption() -> None:
+    _preempt_event.clear()
+
+
+def resume_path() -> str | None:
+    """The checkpoint the supervisor selected for this attempt
+    (``HVD_TPU_RESUME_DIR``, exported by ``python -m horovod_tpu.run`` on
+    relaunch), or None on a fresh start."""
+    return os.environ.get("HVD_TPU_RESUME_DIR") or None
+
+
+class ElasticCheckpoint(NamedTuple):
+    """A restored checkpoint: the step it was taken at, the state pytree,
+    and the resume metadata recorded at save time (rng key, data-iterator
+    offset, ... — whatever the caller passed)."""
+
+    step: int
+    state: Any
+    metadata: dict
+
+
+def _jsonable(obj):
+    """Resume metadata must round-trip through the JSON manifest exactly:
+    array-ish leaves (rng keys!) become nested lists of ints/floats."""
+    if isinstance(obj, dict):
+        return {str(k): _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    if isinstance(obj, (np.ndarray, jax.Array)):
+        return np.asarray(obj).tolist()
+    return obj
+
+
+class CheckpointManager:
+    """Preemption-safe step checkpointing with a completeness manifest.
+
+    Layout: ``directory/step_<N>/state`` holds the orbax payload;
+    ``directory/step_<N>/_COMMIT`` (utils/manifest.py) is written strictly
+    after the payload is durable, so a checkpoint is either *complete* or
+    invisible — a rank killed mid-save can never shadow the last good
+    step.  The launcher's restart supervision reads the same manifest
+    protocol (run.py) to point relaunched jobs at the newest complete
+    step.
+
+    The reference contract is preserved: only rank 0 writes; restore is
+    coordinated so every rank resumes from the same step even when the
+    newest payload turns out to be corrupt (fall back to the previous
+    complete step — tests/test_elastic.py).
+    """
+
+    def __init__(self, directory: str | os.PathLike, *, max_to_keep: int = 2):
+        if max_to_keep < 1:
+            raise ValueError("max_to_keep must be >= 1")
+        self.directory = os.path.abspath(os.fspath(directory))
+        self.max_to_keep = max_to_keep
+        self._pending: list[tuple[int, dict | None]] = []
+        if _rank() == 0:
+            os.makedirs(self.directory, exist_ok=True)
+        # Commit any in-flight background manifest before interpreter
+        # teardown (same _register_atexit reasoning as wait_pending above).
+        register = getattr(threading, "_register_atexit", atexit.register)
+        register(self.drain)
+        atexit.register(self.drain)
+
+    # -- writing ------------------------------------------------------------
+
+    def save(self, step: int, state: Any, *, metadata: dict | None = None,
+             background: bool = False) -> None:
+        """Write ``state`` as checkpoint ``step``; no-op off rank 0.
+
+        ``background=True`` kicks the payload write to the orbax worker
+        thread and defers the commit manifest until the write lands
+        (next ``save``/``drain``/exit) — the checkpoint stays invisible
+        until it is real.  ``metadata`` is the resume record (step is
+        always included; add rng key, data offsets, ... for bit-exact
+        resume)."""
+        if _rank() != 0:
+            return
+        self._flush_pending()
+        path = manifest.step_dir(self.directory, step)
+        if os.path.isdir(path):
+            # Re-saving the same step (restart replay): rewrite atomically
+            # by tearing down the old dir first — its commit marker goes
+            # with it, so readers never see a half-updated mix.
+            shutil.rmtree(path)
+        os.makedirs(path, exist_ok=True)
+        save(os.path.join(path, "state"), state, background=background)
+        if background:
+            self._pending.append((step, metadata))
+        else:
+            self._commit(step, metadata)
+        self._prune()
+
+    def drain(self) -> None:
+        """Block until every in-flight save is durable AND committed.
+
+        This is the preemption drain: the SIGTERM path calls it (via
+        ``save``'s flush or directly) so the job exits with a complete
+        last checkpoint, never a torn one."""
+        if _rank() != 0:
+            return
+        self._flush_pending()
+
+    def _flush_pending(self) -> None:
+        if not self._pending:
+            return
+        wait_pending()
+        for step, md in self._pending:
+            self._commit(step, md)
+        self._pending.clear()
+
+    def _commit(self, step: int, metadata: dict | None) -> None:
+        path = manifest.step_dir(self.directory, step)
+        doc = dict(_jsonable(metadata) if metadata else {})
+        manifest.write_commit(path, step, doc)
+        faults.on_checkpoint_committed(path, step)
+
+    def _prune(self) -> None:
+        committed = manifest.complete_steps(self.directory)
+        keep = set(committed[-self.max_to_keep:])
+        pending = {s for s, _ in self._pending}
+        newest = committed[-1] if committed else None
+        for entry in os.listdir(self.directory):
+            step = manifest.parse_step(entry)
+            if step is None or step in keep or step in pending:
+                continue
+            path = os.path.join(self.directory, entry)
+            if manifest.is_complete(path):
+                shutil.rmtree(path, ignore_errors=True)
+            elif newest is not None and step < newest:
+                # Torn leftovers from a kill mid-save, older than the
+                # newest good step: dead weight, clean them up.
+                shutil.rmtree(path, ignore_errors=True)
+
+    # -- reading ------------------------------------------------------------
+
+    def steps(self) -> list[int]:
+        """Committed step numbers, ascending (rank-local filesystem view)."""
+        return manifest.complete_steps(self.directory)
+
+    def latest_step(self) -> int | None:
+        steps = self.steps()
+        return steps[-1] if steps else None
+
+    def restore_latest(self, template: Any | None = None, *,
+                       broadcast: bool = True) -> ElasticCheckpoint | None:
+        """Restore the newest complete checkpoint, falling back past
+        corrupt/unreadable ones; None when no checkpoint is restorable.
+
+        Coordinated like :func:`restore`: rank 0 picks the step (trying a
+        real read, so a payload that fails to deserialize is skipped with
+        a warning), broadcasts the verdict, and every rank restores the
+        agreed step so the job resumes in lockstep."""
+        coordinated = broadcast and _size() > 1
+        if not coordinated:
+            picked = self._pick_restorable(template)
+            if picked is None:
+                return None
+            step, md = picked
+            state = restore(self._state_path(step), template, broadcast=False)
+            return ElasticCheckpoint(step, state, md)
+        if _rank() == 0:
+            self.drain()
+            header = self._pick_restorable(template)
+        else:
+            header = None
+        header = training.broadcast_object(header, root_rank=0)
+        if header is None:
+            return None
+        step, md = header
+        state = restore(self._state_path(step), template, broadcast=True)
+        return ElasticCheckpoint(step, state, md)
+
+    def _state_path(self, step: int) -> str:
+        return os.path.join(manifest.step_dir(self.directory, step), "state")
+
+    def _pick_restorable(self, template) -> tuple[int, dict] | None:
+        """Newest complete step whose payload actually reads back (rank-0
+        side of the coordinated restore)."""
+        self.drain()
+        for step in reversed(self.steps()):
+            doc = manifest.read_commit(
+                manifest.step_dir(self.directory, step)) or {}
+            try:
+                restore(self._state_path(step), template, broadcast=False)
+            except Exception as exc:  # noqa: BLE001 - any read failure
+                warnings.warn(
+                    f"checkpoint step {step} is complete-marked but "
+                    f"unreadable ({type(exc).__name__}: {exc}); falling "
+                    f"back to the previous complete step")
+                continue
+            return step, doc.get("metadata", {})
+        return None

@@ -74,14 +74,26 @@ def _build_library() -> None:
 
 
 def _load_library() -> ctypes.CDLL:
-    if not os.path.exists(_LIB_PATH):
+    # N launcher-spawned ranks race to build the missing library in the
+    # same directory; a loser can observe a partially-linked .so or a
+    # transient make failure.  Retry the boot on the shared backoff
+    # policy (utils/backoff.py) instead of dying on the race.
+    from horovod_tpu.utils import backoff
+
+    def _boot() -> ctypes.CDLL:
+        # Always run make: it no-ops when the .so is current, and a stale
+        # library left over from before an ABI change would otherwise load
+        # "successfully" and crash in ctypes.
         _build_library()
-    lib = ctypes.CDLL(_LIB_PATH)
+        return ctypes.CDLL(_LIB_PATH)
+
+    lib = backoff.retry(_boot, deadline_s=60.0,
+                        retry_on=(OSError, subprocess.CalledProcessError))
     lib.hvd_create.restype = ctypes.c_void_p
     lib.hvd_create.argtypes = [
         ctypes.c_int, ctypes.c_int, ctypes.c_double, ctypes.c_longlong,
-        ctypes.c_double, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
-        ctypes.c_int]
+        ctypes.c_double, ctypes.c_int, ctypes.c_double, ctypes.c_int,
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
     lib.hvd_start.restype = ctypes.c_int
     lib.hvd_start.argtypes = [ctypes.c_void_p,
                               ctypes.POINTER(ctypes.c_int),
@@ -101,6 +113,9 @@ def _load_library() -> ctypes.CDLL:
     lib.hvd_batch_activity.restype = None
     lib.hvd_batch_activity.argtypes = [ctypes.c_void_p, ctypes.c_longlong,
                                        ctypes.c_char_p]
+    lib.hvd_stall_report.restype = ctypes.c_int
+    lib.hvd_stall_report.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_int]
     lib.hvd_poll.restype = ctypes.c_int
     lib.hvd_poll.argtypes = [ctypes.c_void_p, ctypes.c_longlong]
     lib.hvd_wait.restype = ctypes.c_int
@@ -212,6 +227,8 @@ class NativeEngine:
             env.fusion_threshold_bytes(),
             env.stall_warning_seconds(),
             0 if env.stall_check_disabled() else 1,
+            env.stall_abort_seconds(),
+            env.stall_abort_exit_code(),
             tl.encode() if self._timeline_enabled else None,
             (coordinator_host or "127.0.0.1").encode(),
             coordinator_port)
@@ -263,6 +280,36 @@ class NativeEngine:
 
     def poll(self, handle: int) -> bool:
         return bool(self._lib.hvd_poll(self._ptr, handle))
+
+    def stall_report(self) -> list[tuple[str, list[int]]]:
+        """Structured stall view: [(tensor_name, [missing ranks]), ...].
+
+        Non-empty only on the coordinator (rank 0) while tensors have
+        been waiting past the stall-warning window — the machine-readable
+        form of the reference's log-only CheckForStalledTensors string."""
+        buf = ctypes.create_string_buffer(1 << 16)
+        n = self._lib.hvd_stall_report(self._ptr, buf, len(buf))
+        if n < -1:
+            buf = ctypes.create_string_buffer(-n + 16)
+            n = self._lib.hvd_stall_report(self._ptr, buf, len(buf))
+        if n <= 0:
+            return []
+        raw = buf.raw[:n]
+        off = 0
+
+        def i32():
+            nonlocal off
+            v = struct.unpack_from("<i", raw, off)[0]
+            off += 4
+            return v
+
+        out = []
+        for _ in range(i32()):
+            ln = i32()
+            name = raw[off:off + ln].decode()
+            off += ln
+            out.append((name, [i32() for _ in range(i32())]))
+        return out
 
     def synchronize(self, handle: int, timeout_s: float = 300.0) -> np.ndarray:
         """Block until done; return the result array.  Blocks on the native
@@ -369,6 +416,14 @@ def get_engine() -> NativeEngine:
                                    coordinator_host=host,
                                    coordinator_port=port)
         return _engine
+
+
+def stall_report() -> list[tuple[str, list[int]]]:
+    """Module-level stall report; [] when the engine was never started
+    (nothing can be stalled without the eager control plane)."""
+    with _engine_lock:
+        eng = _engine
+    return eng.stall_report() if eng is not None else []
 
 
 def shutdown_engine() -> None:

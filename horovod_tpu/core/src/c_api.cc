@@ -43,7 +43,8 @@ extern "C" {
 
 void* hvd_create(int rank, int size, double cycle_ms,
                  long long fusion_threshold, double stall_seconds,
-                 int stall_check, const char* timeline_path,
+                 int stall_check, double stall_abort_seconds,
+                 int stall_abort_exit_code, const char* timeline_path,
                  const char* coord_host, int coord_port) {
   EngineOptions opts;
   opts.rank = rank;
@@ -52,6 +53,10 @@ void* hvd_create(int rank, int size, double cycle_ms,
   opts.fusion_threshold_bytes = fusion_threshold;
   opts.stall_warning_seconds = stall_seconds;
   opts.stall_check = stall_check != 0;
+  opts.stall_abort_seconds = stall_abort_seconds;
+  if (stall_abort_exit_code > 0) {
+    opts.stall_abort_exit_code = stall_abort_exit_code;
+  }
   if (timeline_path != nullptr) opts.timeline_path = timeline_path;
   if (coord_host != nullptr) opts.coordinator_host = coord_host;
   opts.coordinator_port = coord_port;
@@ -126,6 +131,26 @@ void hvd_batch_done(void* e, long long batch_id, int status,
   s.type = static_cast<hvd::StatusType>(status);
   if (reason != nullptr) s.reason = reason;
   static_cast<Engine*>(e)->BatchDone(batch_id, s);
+}
+
+// Serialized stall report: i32 count, then per entry {str name,
+// i32 n_missing, i32 ranks...}.  Returns bytes written, or -needed-1 when
+// buflen is too small (caller grows and retries — hvd_next_batch's
+// convention).
+int hvd_stall_report(void* e, char* buf, int buflen) {
+  auto entries = static_cast<Engine*>(e)->StallReport();
+  Writer w;
+  w.i32(static_cast<int32_t>(entries.size()));
+  for (const auto& entry : entries) {
+    w.str(entry.name);
+    w.i32(static_cast<int32_t>(entry.missing_ranks.size()));
+    for (int r : entry.missing_ranks) w.i32(r);
+  }
+  if (static_cast<int>(w.buf.size()) > buflen) {
+    return -static_cast<int>(w.buf.size()) - 1;
+  }
+  std::memcpy(buf, w.buf.data(), w.buf.size());
+  return static_cast<int>(w.buf.size());
 }
 
 int hvd_poll(void* e, long long handle) {

@@ -75,6 +75,29 @@ double RendezvousBudgetSeconds() {
   return 300.0;
 }
 
+// Bounded exponential backoff with jitter — the C++ mirror of
+// horovod_tpu/utils/backoff.py (one retry policy across the stack).
+// Replaces the old fixed 100 ms connect sleep: N workers restarting
+// together decorrelate instead of hammering the coordinator in lockstep.
+struct Backoff {
+  double initial_s;
+  double max_s;
+  unsigned seed;
+  double DelaySeconds(int attempt) {
+    double base = initial_s;
+    for (int k = 0; k < attempt && base < max_s; ++k) base *= 2.0;
+    if (base > max_s) base = max_s;
+    double u = static_cast<double>(rand_r(&seed)) / RAND_MAX;
+    return base / 2.0 + u * (base / 2.0);
+  }
+  void Sleep(int attempt, double budget_left_s) {
+    double d = DelaySeconds(attempt);
+    if (d > budget_left_s) d = budget_left_s;
+    if (d <= 0) return;
+    ::usleep(static_cast<useconds_t>(d * 1e6));
+  }
+};
+
 }  // namespace
 
 // ---------------------------------------------------------------------------
@@ -199,7 +222,8 @@ std::unique_ptr<TcpControlPlane> TcpControlPlane::MakeWorker(
   // shared rendezvous budget runs out.
   auto deadline = std::chrono::steady_clock::now() +
                   std::chrono::duration<double>(RendezvousBudgetSeconds());
-  for (;;) {
+  Backoff backoff{0.02, 1.0, static_cast<unsigned>(rank + 1)};
+  for (int attempt = 0;; ++attempt) {
     cp->sock_ = ::socket(AF_INET, SOCK_STREAM, 0);
     if (cp->sock_ < 0) {
       *err = "socket() failed";
@@ -212,12 +236,14 @@ std::unique_ptr<TcpControlPlane> TcpControlPlane::MakeWorker(
     }
     ::close(cp->sock_);
     cp->sock_ = -1;
-    if (std::chrono::steady_clock::now() >= deadline) {
+    double left = std::chrono::duration<double>(
+        deadline - std::chrono::steady_clock::now()).count();
+    if (left <= 0) {
       *err = "connect to " + host + ":" + std::to_string(port) +
              " failed (HVD_TPU_CONNECT_TIMEOUT to extend)";
       return nullptr;
     }
-    ::usleep(100 * 1000);
+    backoff.Sleep(attempt, left);
   }
   std::string hello(4, '\0');
   int32_t r32 = rank;
@@ -478,15 +504,10 @@ ResponseList Coordinator::Tick(const std::vector<RequestList>& gathered) {
   return out;
 }
 
-std::string Coordinator::CheckStalled() {
-  if (!stall_check_ || table_.empty()) return "";
+std::vector<StallEntry> Coordinator::StalledTensors() const {
+  std::vector<StallEntry> out;
+  if (!stall_check_ || table_.empty()) return out;
   auto now = std::chrono::steady_clock::now();
-  if (std::chrono::duration<double>(now - last_stall_warn_).count() <
-      stall_seconds_) {
-    return "";
-  }
-  std::ostringstream msg;
-  bool any = false;
   for (const auto& name : fifo_) {
     auto it = table_.find(name);
     if (it == table_.end()) continue;
@@ -494,22 +515,50 @@ std::string Coordinator::CheckStalled() {
     double waited =
         std::chrono::duration<double>(now - rec.first_seen).count();
     if (waited < stall_seconds_) continue;
-    if (!any) {
-      msg << "One or more tensors were submitted to be reduced, gathered or "
-             "broadcasted by subset of ranks and are waiting for remainder of "
-             "ranks for more than " << static_cast<int>(stall_seconds_)
-          << " seconds. This may indicate that different ranks are trying to "
-             "submit different tensors or that only subset of ranks is "
-             "submitting tensors, which will cause deadlock.\n";
-      any = true;
-    }
-    msg << "Stalled op: " << name << " [missing ranks:";
+    StallEntry e;
+    e.name = name;
+    e.waited_seconds = waited;
     for (int r = 0; r < size_; ++r) {
-      if (!rec.ready[static_cast<size_t>(r)]) msg << " " << r;
+      if (!rec.ready[static_cast<size_t>(r)]) e.missing_ranks.push_back(r);
     }
+    out.push_back(std::move(e));
+  }
+  return out;
+}
+
+double Coordinator::OldestPendingSeconds() const {
+  if (table_.empty()) return 0;
+  auto now = std::chrono::steady_clock::now();
+  double oldest = 0;
+  for (const auto& [name, rec] : table_) {
+    double waited =
+        std::chrono::duration<double>(now - rec.first_seen).count();
+    if (waited > oldest) oldest = waited;
+  }
+  return oldest;
+}
+
+std::string Coordinator::CheckStalled() {
+  if (!stall_check_ || table_.empty()) return "";
+  auto now = std::chrono::steady_clock::now();
+  if (std::chrono::duration<double>(now - last_stall_warn_).count() <
+      stall_seconds_) {
+    return "";
+  }
+  std::vector<StallEntry> stalled = StalledTensors();
+  if (stalled.empty()) return "";
+  std::ostringstream msg;
+  msg << "One or more tensors were submitted to be reduced, gathered or "
+         "broadcasted by subset of ranks and are waiting for remainder of "
+         "ranks for more than " << static_cast<int>(stall_seconds_)
+      << " seconds. This may indicate that different ranks are trying to "
+         "submit different tensors or that only subset of ranks is "
+         "submitting tensors, which will cause deadlock.\n";
+  for (const auto& e : stalled) {
+    msg << "Stalled op: " << e.name << " [missing ranks:";
+    for (int r : e.missing_ranks) msg << " " << r;
     msg << "]\n";
   }
-  if (!any) return "";
   last_stall_warn_ = now;
   return msg.str();
 }

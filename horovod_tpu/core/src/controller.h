@@ -95,6 +95,15 @@ struct TensorRecord {
   std::chrono::steady_clock::time_point first_seen;
 };
 
+// One stalled tensor in a structured stall report (the machine-readable
+// form of the reference's log-only CheckForStalledTensors warning) —
+// surfaced to Python as hvd.stall_report().
+struct StallEntry {
+  std::string name;
+  std::vector<int> missing_ranks;
+  double waited_seconds = 0;
+};
+
 // The coordinator's negotiation state machine.  Single-threaded use (from
 // the engine's background thread).
 class Coordinator {
@@ -114,6 +123,14 @@ class Coordinator {
   // human-readable warning (empty if none) listing tensors waiting on
   // missing ranks for longer than the stall window.
   std::string CheckStalled();
+
+  // Structured view of the same condition, rate-limit-free: every tensor
+  // currently past the stall window with the ranks it is waiting on.
+  std::vector<StallEntry> StalledTensors() const;
+
+  // Seconds the oldest pending tensor has been waiting (0 when none) —
+  // drives the stall-abort escalation (engine.cc).
+  double OldestPendingSeconds() const;
 
   size_t pending() const { return table_.size(); }
 

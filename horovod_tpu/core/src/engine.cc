@@ -3,6 +3,7 @@
 #include <algorithm>
 #include <chrono>
 #include <cstdio>
+#include <cstdlib>
 
 namespace hvd {
 
@@ -116,6 +117,27 @@ void Engine::RunCycle() {
     std::string stall = coordinator_->CheckStalled();
     if (!stall.empty()) {
       std::fprintf(stderr, "WARNING: %s", stall.c_str());
+    }
+    {
+      // Publish the structured stall view for hvd.stall_report().
+      std::lock_guard<std::mutex> l(mu_);
+      last_stall_ = coordinator_->StalledTensors();
+    }
+    // Escalation: warn -> abort.  A deadlocked job must become a
+    // restartable exit for the launcher's supervision, not a hang the
+    // operator discovers hours later (reference's stall story stopped at
+    // the warning).  _Exit, not exit: the process is wedged by
+    // definition — running atexit handlers (which may join the very
+    // threads that are stuck) would turn the abort back into a hang.
+    if (opts_.stall_abort_seconds > 0 &&
+        coordinator_->OldestPendingSeconds() >= opts_.stall_abort_seconds) {
+      std::fprintf(stderr,
+                   "ERROR: horovod_tpu stall exceeded "
+                   "HVD_TPU_STALL_ABORT_SECONDS=%.3f; aborting job with "
+                   "restartable exit code %d\n",
+                   opts_.stall_abort_seconds, opts_.stall_abort_exit_code);
+      std::fflush(stderr);
+      std::_Exit(opts_.stall_abort_exit_code);
     }
     if (!control_->Broadcast(responses)) {
       FailAllPending(Status::Aborted("control plane broadcast failed"));
@@ -308,6 +330,11 @@ void Engine::MarkDone(int64_t handle, const Status& status) {
   it->second.done = true;
   it->second.status = status;
   done_cv_.notify_all();
+}
+
+std::vector<StallEntry> Engine::StallReport() {
+  std::lock_guard<std::mutex> l(mu_);
+  return last_stall_;
 }
 
 bool Engine::PollHandle(int64_t handle) {

@@ -53,6 +53,13 @@ struct EngineOptions {
   int64_t fusion_threshold_bytes = 64 * 1024 * 1024;
   double stall_warning_seconds = 60.0;
   bool stall_check = true;
+  // Stall escalation (warn -> abort): when > 0 and a tensor has been
+  // pending longer than this, the coordinator aborts the PROCESS with
+  // stall_abort_exit_code — a distinct, restartable exit the launcher's
+  // supervision recognizes, instead of a silent deadlock
+  // (HVD_TPU_STALL_ABORT_SECONDS; docs/fault_tolerance.md).
+  double stall_abort_seconds = 0;
+  int stall_abort_exit_code = 75;  // EX_TEMPFAIL: transient, retry me
   std::string timeline_path;      // empty = disabled
   std::string coordinator_host;   // workers (rank>0)
   int coordinator_port = 0;       // 0 = pick ephemeral (coordinator)
@@ -87,6 +94,11 @@ class Engine {
   // operations.cc:698-710: QUEUE, MEMCPY_IN_FUSION_BUFFER, <collective>,
   // MEMCPY_OUT_FUSION_BUFFER).  No-op when the timeline is disabled.
   void BatchActivity(int64_t batch_id, const std::string& activity);
+
+  // Structured stall report: the tensors the coordinator is warning
+  // about (empty on workers and when nothing is stalled).  Thread-safe
+  // snapshot of the last cycle's view — hvd.stall_report() in Python.
+  std::vector<StallEntry> StallReport();
 
   // Handle table (reference torch/handle_manager.{h,cc}).
   bool PollHandle(int64_t handle);                 // true = done
@@ -125,6 +137,7 @@ class Engine {
     Status status;
   };
   std::unordered_map<int64_t, HandleState> handles_;
+  std::vector<StallEntry> last_stall_;  // guarded by mu_
   int64_t next_handle_ = 0;
   int64_t next_batch_id_ = 0;
 

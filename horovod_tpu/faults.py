@@ -1,0 +1,208 @@
+"""Deterministic fault injection — the proving ground for elasticity.
+
+Every robustness claim in docs/fault_tolerance.md is exercised by killing
+a specific rank at a specific step, stalling a rank (the dropped-
+controller-message analog), delaying its participation, or corrupting a
+checkpoint payload after commit — all driven by environment variables so
+the whole scenario replays bit-identically under ``JAX_PLATFORMS=cpu``
+(tests/test_elastic.py, bench.py ``--fault``).
+
+Injectors (all opt-in; absent env == no faults):
+
+* ``HVD_TPU_FAULT_KILL_RANK`` / ``HVD_TPU_FAULT_KILL_STEP`` — when the
+  named rank reaches the step, it dies by signal
+  (``HVD_TPU_FAULT_KILL_SIGNAL``, default SIGKILL) — the TPU-preemption
+  stand-in.
+* ``HVD_TPU_FAULT_STALL_RANK`` / ``HVD_TPU_FAULT_STALL_STEP`` — the rank
+  stops participating forever (its controller messages are effectively
+  dropped); drives the coordinator's stall warn -> abort escalation.
+* ``HVD_TPU_FAULT_DELAY_RANK`` / ``HVD_TPU_FAULT_DELAY_STEP`` /
+  ``HVD_TPU_FAULT_DELAY_MS`` — one bounded delay (default 500 ms), the
+  slow-worker / delayed-message case.
+* ``HVD_TPU_FAULT_CORRUPT_STEP`` — after checkpoint ``step`` commits,
+  rank 0 overwrites part of its payload with garbage (bit-rot / torn
+  upload); proves restore falls back to the previous complete step.
+* ``HVD_TPU_FAULT_ON_ATTEMPT`` (default 0) — faults fire only when the
+  launcher-exported ``HVD_TPU_RESTART_ATTEMPT`` matches, so an injected
+  crash consumes exactly one restart and the relaunched job runs clean.
+
+Hooks: training loops call :func:`step` once per step (wired through
+``training.elastic_loop`` and ``callbacks.PreemptionCheckpointCallback``);
+``checkpoint.CheckpointManager`` calls :func:`on_checkpoint_committed`.
+Tests and bench.py may bypass env parsing with :func:`install`.
+
+jax-free by design: the injectors must work in processes that never
+touch a backend (engine-only workers, the launcher's children before
+``hvd.init()``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import sys
+import time
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """Parsed injector configuration (None field == injector disabled)."""
+
+    kill_rank: int | None = None
+    kill_step: int | None = None
+    kill_signal: int = signal.SIGKILL
+    stall_rank: int | None = None
+    stall_step: int | None = None
+    delay_rank: int | None = None
+    delay_step: int | None = None
+    delay_ms: float = 500.0
+    corrupt_step: int | None = None
+    on_attempt: int = 0
+
+    def any_active(self) -> bool:
+        return any(v is not None for v in (
+            self.kill_rank, self.stall_rank, self.delay_rank,
+            self.corrupt_step))
+
+
+def _int_env(name: str) -> int | None:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return None
+    return int(raw)
+
+
+def _plan_from_env() -> FaultPlan:
+    sig_raw = os.environ.get("HVD_TPU_FAULT_KILL_SIGNAL", "KILL")
+    sig = getattr(signal, f"SIG{sig_raw}", None) if not sig_raw.isdigit() \
+        else int(sig_raw)
+    if sig is None:
+        raise ValueError(f"unknown HVD_TPU_FAULT_KILL_SIGNAL={sig_raw}")
+    return FaultPlan(
+        kill_rank=_int_env("HVD_TPU_FAULT_KILL_RANK"),
+        kill_step=_int_env("HVD_TPU_FAULT_KILL_STEP"),
+        kill_signal=int(sig),
+        stall_rank=_int_env("HVD_TPU_FAULT_STALL_RANK"),
+        stall_step=_int_env("HVD_TPU_FAULT_STALL_STEP"),
+        delay_rank=_int_env("HVD_TPU_FAULT_DELAY_RANK"),
+        delay_step=_int_env("HVD_TPU_FAULT_DELAY_STEP"),
+        delay_ms=float(os.environ.get("HVD_TPU_FAULT_DELAY_MS", "500")),
+        corrupt_step=_int_env("HVD_TPU_FAULT_CORRUPT_STEP"),
+        on_attempt=_int_env("HVD_TPU_FAULT_ON_ATTEMPT") or 0,
+    )
+
+
+_plan: FaultPlan | None = None
+_delay_fired = False
+
+
+def plan() -> FaultPlan:
+    """The active plan (env-derived unless :func:`install` overrode it)."""
+    global _plan
+    if _plan is None:
+        _plan = _plan_from_env()
+    return _plan
+
+
+def install(**kwargs) -> FaultPlan:
+    """Programmatic installation (tests, bench.py) — replaces the env plan."""
+    global _plan, _delay_fired
+    _plan = FaultPlan(**kwargs)
+    _delay_fired = False
+    return _plan
+
+
+def clear() -> None:
+    """Drop any installed/cached plan; env is re-read on next use."""
+    global _plan, _delay_fired
+    _plan = None
+    _delay_fired = False
+
+
+def _attempt() -> int:
+    """The launcher's restart attempt counter (0 outside supervision)."""
+    return _int_env("HVD_TPU_RESTART_ATTEMPT") or 0
+
+
+def _rank(explicit: int | None) -> int:
+    if explicit is not None:
+        return explicit
+    from horovod_tpu import basics
+
+    if basics.is_initialized():
+        return basics.rank()
+    return _int_env("JAX_PROCESS_ID") or 0
+
+
+def armed() -> bool:
+    """True when any injector could fire for this process's attempt."""
+    p = plan()
+    return p.any_active() and _attempt() == p.on_attempt
+
+
+def step(step_num: int, rank: int | None = None) -> None:
+    """Per-training-step hook: fire any step-indexed injector that matches.
+
+    Cheap when disarmed (one dataclass read, no syscalls); call it from
+    every training loop that wants to be fault-testable.
+    """
+    global _delay_fired
+    p = plan()
+    if not p.any_active() or _attempt() != p.on_attempt:
+        return
+    r = _rank(rank)
+    if p.delay_rank == r and p.delay_step == step_num and not _delay_fired:
+        _delay_fired = True
+        time.sleep(p.delay_ms / 1000.0)
+    if p.stall_rank == r and p.stall_step is not None \
+            and step_num >= p.stall_step:
+        sys.stderr.write(
+            f"horovod_tpu.faults: rank {r} stalling at step {step_num} "
+            f"(injected)\n")
+        sys.stderr.flush()
+        while True:  # hold the rank hostage: the stall escalation or the
+            time.sleep(0.25)  # supervisor must reap us, never this loop
+    if p.kill_rank == r and p.kill_step == step_num:
+        sys.stderr.write(
+            f"horovod_tpu.faults: killing rank {r} at step {step_num} with "
+            f"signal {p.kill_signal} (injected)\n")
+        sys.stderr.flush()
+        sys.stdout.flush()
+        os.kill(os.getpid(), p.kill_signal)
+        time.sleep(60)  # SIGKILL needs no help; catchable signals get a
+        os._exit(128 + p.kill_signal)  # bounded grace, then hard exit
+
+
+def on_checkpoint_committed(path: str, step_num: int,
+                            rank: int | None = None) -> None:
+    """Post-commit hook: corrupt the payload of checkpoint ``step_num``.
+
+    Overwrites the head of the largest payload file under ``path`` with
+    garbage AFTER the commit manifest exists — the nastiest case, where
+    completeness metadata says "good" but the bytes are not, so restore's
+    fall-back-on-deserialize-failure path is what saves the job.
+    """
+    p = plan()
+    if p.corrupt_step != step_num or _attempt() != p.on_attempt:
+        return
+    if _rank(rank) != 0:
+        return
+    victim, vsize = None, -1
+    for root, _dirs, files in os.walk(path):
+        for f in files:
+            fp = os.path.join(root, f)
+            try:
+                size = os.path.getsize(fp)
+            except OSError:
+                continue
+            if size > vsize and not f.startswith("_COMMIT"):
+                victim, vsize = fp, size
+    if victim is None:
+        return
+    with open(victim, "r+b") as f:
+        f.write(b"\xde\xad\xbe\xef" * max(1, min(vsize, 4096) // 4))
+    sys.stderr.write(
+        f"horovod_tpu.faults: corrupted checkpoint payload {victim} "
+        f"(step {step_num}, injected)\n")
+    sys.stderr.flush()

@@ -44,6 +44,9 @@ from jax.sharding import PartitionSpec
 from horovod_tpu import basics, mesh
 from horovod_tpu.ops.compression import Compression
 from horovod_tpu.ops import fusion
+from horovod_tpu.utils import jaxcompat
+
+jaxcompat.install()  # jax.shard_map on older pinned jax releases
 
 Average = True  # default matches reference allreduce(average=True)
 

@@ -30,6 +30,10 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+
+from horovod_tpu.utils import jaxcompat
+
+jaxcompat.install()  # pltpu.CompilerParams spelling on older releases
 try:  # TPU-specific memory spaces; absent on some CPU-only builds
     from jax.experimental.pallas import tpu as pltpu
     _SMEM = pltpu.SMEM

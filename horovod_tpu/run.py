@@ -23,6 +23,24 @@ What it does for each of the N ranks:
   remaining ranks and exits with that rank's code — matching mpirun's
   job-abort contract so a crashed rank can never leave the job hung.
 
+Beyond mpirun (the elastic/torchrun lineage, docs/fault_tolerance.md):
+
+* **Supervision** — ``--max-restarts N`` relaunches the whole job after an
+  abnormal exit (a preempted TPU VM, a flaky worker, the stall-abort
+  escalation), with exponential backoff between attempts and a crash-loop
+  breaker: only failures within ``--restart-window`` seconds of launch
+  consume restart budget; a job that ran longer earns its counter back.
+* **Restart-from-checkpoint** — with ``--ckpt-dir``, every attempt points
+  children at the newest *complete* checkpoint (utils/manifest.py commit
+  protocol) via ``HVD_TPU_RESUME_DIR``; ``HVD_TPU_RESTART_ATTEMPT``
+  carries the attempt counter (fault injectors key off it, faults.py).
+* **Preemption drain** — SIGTERM/SIGINT to the launcher forwards the
+  signal to every rank's *process group* (``os.killpg`` — grandchildren
+  such as data-loader workers cannot be orphaned), waits up to
+  ``--drain-secs`` for ranks to checkpoint and exit (see
+  ``checkpoint.install_preemption_handler``), then escalates to SIGKILL.
+  No restarts after a drain request.
+
 Multi-host dispatch (``-H host1:2,...``) is intentionally not implemented:
 TPU pods launch per-host processes through the pod runtime, not ssh; the
 error message points at docs/running.md.
@@ -38,6 +56,11 @@ import subprocess
 import sys
 import threading
 import time
+
+# Jax-free imports only: the supervising parent must stay a lightweight
+# process (it may live for days babysitting restarts).
+from horovod_tpu.utils import manifest
+from horovod_tpu.utils.backoff import Backoff
 
 _TERM_GRACE_SECONDS = 5.0
 
@@ -62,7 +85,8 @@ def _pump(stream, rank: int, tag: bool, lock: threading.Lock) -> None:
 
 
 def _child_env(rank: int, np_: int, jax_port: int, coord_port: int,
-               platform: str | None) -> dict[str, str]:
+               platform: str | None, attempt: int,
+               resume_dir: str | None) -> dict[str, str]:
     env = dict(os.environ)
     env["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{jax_port}"
     env["JAX_NUM_PROCESSES"] = str(np_)
@@ -70,6 +94,11 @@ def _child_env(rank: int, np_: int, jax_port: int, coord_port: int,
     env["HVD_TPU_COORDINATOR_HOST"] = "127.0.0.1"
     env["HVD_TPU_COORDINATOR_PORT"] = str(coord_port)
     env.setdefault("HVD_TPU_EXECUTOR", "multihost")
+    env["HVD_TPU_RESTART_ATTEMPT"] = str(attempt)
+    if resume_dir is not None:
+        env["HVD_TPU_RESUME_DIR"] = resume_dir
+    else:
+        env.pop("HVD_TPU_RESUME_DIR", None)
     if platform:
         env["JAX_PLATFORMS"] = platform
         if platform == "cpu":
@@ -87,11 +116,130 @@ def _child_env(rank: int, np_: int, jax_port: int, coord_port: int,
     return env
 
 
+def _signal_job(procs: list[subprocess.Popen], sig: int) -> None:
+    """Deliver ``sig`` to every live rank's WHOLE process group.
+
+    Children are session leaders (start_new_session), so killpg reaches
+    grandchildren too — a preempted supervisor must not orphan data-loader
+    or build subprocesses.  Racing a just-exited child is fine: the
+    process-group id stays valid until the child is reaped, and a gone
+    group is exactly the done case."""
+    for p in procs:
+        if p.poll() is not None:
+            continue
+        try:
+            os.killpg(p.pid, sig)
+        except (ProcessLookupError, PermissionError):
+            try:
+                p.send_signal(sig)
+            except (ProcessLookupError, OSError):
+                pass
+
+
+class _StopRequest:
+    """Set by the launcher's own SIGTERM/SIGINT: drain, don't restart."""
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.signum = signal.SIGTERM
+
+
+def _run_once(command: list[str], args, attempt: int,
+              resume_dir: str | None, stop: _StopRequest,
+              lock: threading.Lock) -> int:
+    """Launch all ranks once; return the job's exit code (0 = clean)."""
+    jax_port, coord_port = _free_port(), _free_port()
+    procs: list[subprocess.Popen] = []
+    pumps: list[threading.Thread] = []
+    try:
+        for rank in range(args.np_):
+            p = subprocess.Popen(
+                command,
+                env=_child_env(rank, args.np_, jax_port, coord_port,
+                               args.platform or None, attempt, resume_dir),
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                start_new_session=True)
+            procs.append(p)
+            t = threading.Thread(target=_pump,
+                                 args=(p.stdout, rank,
+                                       not args.no_tag_output, lock),
+                                 daemon=True)
+            t.start()
+            pumps.append(t)
+    except BaseException:
+        # A failed spawn (fork EAGAIN, bad command) must not leak the ranks
+        # already started — they'd sit in the rendezvous for its full budget.
+        _signal_job(procs, signal.SIGKILL)
+        raise
+
+    # Expose the live procs to the launcher's signal handler.
+    _current_procs[:] = procs
+
+    exit_code = 0
+    remaining = set(range(args.np_))
+    drain_deadline: float | None = None
+    try:
+        while remaining:
+            if stop.event.is_set() and drain_deadline is None:
+                # Drain: forward the signal to every process group and give
+                # ranks --drain-secs to checkpoint and exit cleanly.
+                drain_deadline = time.monotonic() + args.drain_secs
+                _signal_job(procs, stop.signum)
+            if drain_deadline is not None \
+                    and time.monotonic() >= drain_deadline:
+                _signal_job(procs, signal.SIGKILL)
+                drain_deadline = float("inf")  # escalate once
+            done = [r for r in remaining if procs[r].poll() is not None]
+            if not done:
+                time.sleep(0.05)
+                continue
+            for r in done:
+                remaining.discard(r)
+                rc = procs[r].returncode
+                if rc < 0:  # killed by signal: report as 128+signum
+                    rc = 128 - rc
+                if rc != 0 and exit_code == 0:
+                    exit_code = rc
+                    if not stop.event.is_set():
+                        with lock:
+                            sys.stderr.write(
+                                f"horovod_tpu.run: rank {r} exited with code "
+                                f"{rc}; terminating remaining ranks\n")
+                        # mpirun contract: first abnormal exit aborts the
+                        # job (SIGTERM first, SIGKILL after the grace).
+                        live = [procs[o] for o in remaining]
+                        _signal_job(live, signal.SIGTERM)
+                        deadline = time.monotonic() + _TERM_GRACE_SECONDS
+                        for other in remaining:
+                            left = deadline - time.monotonic()
+                            try:
+                                procs[other].wait(timeout=max(left, 0.01))
+                            except subprocess.TimeoutExpired:
+                                pass
+                        _signal_job(live, signal.SIGKILL)
+    finally:
+        _signal_job(procs, signal.SIGKILL)
+        for p in procs:
+            try:
+                p.wait(timeout=2.0)
+            except subprocess.TimeoutExpired:
+                pass
+        for t in pumps:
+            t.join(timeout=2.0)
+        _current_procs[:] = []
+    return exit_code
+
+
+# Live ranks of the current attempt — the signal handler's view.
+_current_procs: list[subprocess.Popen] = []
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m horovod_tpu.run",
         description="Launch N cooperating horovod_tpu processes on this host "
-                    "(the mpirun -np analog; see docs/running.md).")
+                    "(the mpirun -np analog; see docs/running.md and "
+                    "docs/fault_tolerance.md).")
     parser.add_argument("-np", "--num-proc", type=int, required=True,
                         dest="np_", metavar="N",
                         help="number of processes to launch")
@@ -104,6 +252,23 @@ def main(argv: list[str] | None = None) -> int:
                              "pass '' to inherit the parent's platform)")
     parser.add_argument("--no-tag-output", action="store_true",
                         help="do not prefix child output with '[rank]: '")
+    parser.add_argument("--max-restarts", type=int, default=0,
+                        help="relaunch the whole job up to N times after an "
+                             "abnormal exit (default 0: mpirun's abort-only "
+                             "contract)")
+    parser.add_argument("--restart-window", type=float, default=60.0,
+                        metavar="SECS",
+                        help="crash-loop breaker: only failures within SECS "
+                             "of launch consume restart budget; a longer run "
+                             "resets the spent counter (default 60)")
+    parser.add_argument("--ckpt-dir", default=None,
+                        help="checkpoint root (checkpoint.CheckpointManager "
+                             "layout); each attempt resolves the newest "
+                             "COMPLETE step and exports HVD_TPU_RESUME_DIR "
+                             "to children")
+    parser.add_argument("--drain-secs", type=float, default=30.0,
+                        help="grace between forwarding SIGTERM to ranks and "
+                             "SIGKILL escalation (default 30)")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="program and arguments (e.g. python train.py)")
     args = parser.parse_args(argv)
@@ -114,83 +279,80 @@ def main(argv: list[str] | None = None) -> int:
                      "(docs/running.md 'Multi-host TPU pod slice')")
     if args.np_ < 1:
         parser.error("-np must be >= 1")
+    if args.max_restarts < 0:
+        parser.error("--max-restarts must be >= 0")
     command = args.command
     if command and command[0] == "--":
         command = command[1:]
     if not command:
         parser.error("no command given (e.g. ... -np 2 python train.py)")
 
-    jax_port, coord_port = _free_port(), _free_port()
     lock = threading.Lock()
-    procs: list[subprocess.Popen] = []
-    pumps: list[threading.Thread] = []
-    try:
-        for rank in range(args.np_):
-            p = subprocess.Popen(
-                command,
-                env=_child_env(rank, args.np_, jax_port, coord_port,
-                               args.platform or None),
-                stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
-            procs.append(p)
-            t = threading.Thread(target=_pump,
-                                 args=(p.stdout, rank,
-                                       not args.no_tag_output, lock),
-                                 daemon=True)
-            t.start()
-            pumps.append(t)
-    except BaseException:
-        # A failed spawn (fork EAGAIN, bad command) must not leak the ranks
-        # already started — they'd sit in the rendezvous for its full budget.
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-        raise
+    stop = _StopRequest()
 
-    def _abort(signum, frame):  # forward Ctrl-C / SIGTERM to the whole job
-        for p in procs:
-            if p.poll() is None:
-                p.terminate()
+    def _on_signal(signum, frame):
+        stop.signum = signal.SIGTERM if signum == signal.SIGTERM \
+            else signal.SIGINT
+        stop.event.set()
+        # Forward immediately too: _run_once's loop would also do it within
+        # a poll tick, but a second Ctrl-C must escalate promptly.
+        _signal_job(list(_current_procs), stop.signum)
 
-    signal.signal(signal.SIGINT, _abort)
-    signal.signal(signal.SIGTERM, _abort)
+    signal.signal(signal.SIGINT, _on_signal)
+    signal.signal(signal.SIGTERM, _on_signal)
 
-    # mpirun contract: first abnormal exit aborts the job.  Poll until every
-    # rank finishes or one fails; on failure, give the rest a grace period
-    # then kill.
-    exit_code = 0
-    remaining = set(range(args.np_))
-    try:
-        while remaining:
-            done = [r for r in remaining if procs[r].poll() is not None]
-            if not done:
-                time.sleep(0.05)
-                continue
-            for r in done:
-                remaining.discard(r)
-                rc = procs[r].returncode
-                if rc < 0:  # killed by signal: report as 128+signum
-                    rc = 128 - rc
-                if rc != 0 and exit_code == 0:
-                    exit_code = rc
-                    with lock:
-                        sys.stderr.write(
-                            f"horovod_tpu.run: rank {r} exited with code "
-                            f"{rc}; terminating remaining ranks\n")
-                    for other in remaining:
-                        if procs[other].poll() is None:
-                            procs[other].terminate()
-                    for other in remaining:
-                        try:
-                            procs[other].wait(timeout=_TERM_GRACE_SECONDS)
-                        except subprocess.TimeoutExpired:
-                            procs[other].kill()
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-        for t in pumps:
-            t.join(timeout=2.0)
-    return exit_code
+    # HVD_TPU_RESTART_BACKOFF tunes the first restart delay (tests shrink
+    # it); the schedule is the shared bounded-exponential-with-jitter
+    # policy (utils/backoff.py).
+    initial = float(os.environ.get("HVD_TPU_RESTART_BACKOFF", "1.0") or 1.0)
+    backoff = Backoff(initial_s=initial, max_s=max(30.0, initial))
+
+    attempt = 0
+    spent_restarts = 0
+    while True:
+        resume_dir = None
+        if args.ckpt_dir:
+            newest = manifest.latest_complete(args.ckpt_dir)
+            if newest is not None:
+                resume_dir = newest[1]
+        if attempt > 0:
+            with lock:
+                sys.stderr.write(
+                    f"horovod_tpu.run: relaunching attempt {attempt} "
+                    + (f"from checkpoint {resume_dir}\n" if resume_dir
+                       else "from scratch (no complete checkpoint)\n"))
+        started = time.monotonic()
+        exit_code = _run_once(command, args, attempt, resume_dir, stop, lock)
+        ran_s = time.monotonic() - started
+        if stop.event.is_set():
+            # Drained on request: the children's own exit codes tell whether
+            # the checkpoint landed (0 = clean drain).  Never restart.
+            return exit_code
+        if exit_code == 0:
+            return 0
+        if ran_s >= args.restart_window:
+            spent_restarts = 0  # healthy run before the failure: earn back
+        if spent_restarts >= args.max_restarts:
+            if args.max_restarts > 0:
+                with lock:
+                    sys.stderr.write(
+                        f"horovod_tpu.run: restart budget exhausted "
+                        f"({args.max_restarts} within {args.restart_window:g}"
+                        f"s); giving up with exit code {exit_code}\n")
+            return exit_code
+        delay = backoff.delay(spent_restarts)
+        spent_restarts += 1
+        attempt += 1
+        with lock:
+            sys.stderr.write(
+                f"horovod_tpu.run: job failed with exit code {exit_code} "
+                f"after {ran_s:.1f}s; restarting (attempt {attempt}, "
+                f"{spent_restarts}/{args.max_restarts} restarts spent) "
+                f"in {delay:.2f}s\n")
+        # Interruptible backoff: a drain request during the sleep exits
+        # immediately instead of launching another attempt.
+        if stop.event.wait(timeout=delay):
+            return exit_code
 
 
 if __name__ == "__main__":

@@ -309,6 +309,83 @@ def allgather_object(obj):
     return _ao(obj)
 
 
+def elastic_loop(step_fn, state, *, num_steps: int, manager=None,
+                 checkpoint_every: int = 1, metadata_fn=None,
+                 resume: bool = True, on_resume=None):
+    """Drive ``step_fn`` with fault hooks and preemption-safe checkpoints.
+
+    The minimal elastic training driver (torchrun-lineage supervision for
+    our synchronous SPMD world — docs/fault_tolerance.md): each step runs
+    ``state = step_fn(step, state)`` after advancing the fault-injection
+    clock; every ``checkpoint_every`` completed steps the ``manager``
+    (checkpoint.CheckpointManager) records a complete checkpoint; a
+    preemption signal drains a final synchronous checkpoint and exits 0
+    so ``python -m horovod_tpu.run`` knows the state is durable.
+
+    With ``resume=True`` (default) the loop first restores the newest
+    complete checkpoint and continues from the step after it — restart
+    equals continuation, which is what makes the launcher's
+    ``--max-restarts`` relaunch bit-exact.  ``on_resume(ckpt)`` (an
+    :class:`~horovod_tpu.checkpoint.ElasticCheckpoint`) lets the caller
+    re-seat rng/data-iterator position from the resume metadata.
+
+    Returns the final state.
+    """
+    import sys as _sys
+
+    from horovod_tpu import checkpoint as _checkpoint
+    from horovod_tpu import faults as _faults
+
+    start_step = 0
+    if manager is not None:
+        _checkpoint.install_preemption_handler()
+        if resume:
+            ckpt = manager.restore_latest(template=state)
+            if ckpt is not None:
+                state = ckpt.state
+                start_step = ckpt.step + 1
+                if on_resume is not None:
+                    on_resume(ckpt)
+
+    def _metadata(step):
+        md = {"step": step}
+        if metadata_fn is not None:
+            md.update(metadata_fn(step))
+        return md
+
+    def _drain_exit(step, state):
+        if step >= 0:  # step -1 == preempted before any step completed
+            manager.save(step, state, metadata=_metadata(step))
+        manager.drain()
+        _sys.exit(0)
+
+    for step in range(start_step, num_steps):
+        if manager is not None and _checkpoint.preemption_requested():
+            _drain_exit(step - 1, state)
+        _faults.step(step)
+        try:
+            state = step_fn(step, state)
+        except Exception:
+            # A peer that drained on the same preemption signal tears the
+            # collectives down under us (coordinated engine shutdown);
+            # when OUR flag is up too, that failure IS the drain — save
+            # the last completed step's state and exit clean.  Anything
+            # else propagates: real failures must abort the job so the
+            # launcher's supervision can restart it.
+            if manager is not None and _checkpoint.preemption_requested():
+                _drain_exit(step - 1, state)
+            raise
+        if manager is not None:
+            if _checkpoint.preemption_requested():
+                _drain_exit(step, state)
+            if (step + 1) % max(checkpoint_every, 1) == 0 \
+                    or step == num_steps - 1:
+                manager.save(step, state, metadata=_metadata(step))
+    if manager is not None:
+        manager.drain()
+    return state
+
+
 def broadcast_object(obj, root_rank: int = 0):
     """Broadcast an arbitrary picklable object across processes.
 

@@ -7,7 +7,7 @@ profiling module (and its jax dependency) eagerly.
 
 import importlib
 
-_SUBMODULES = ("env", "profiling")
+_SUBMODULES = ("backoff", "env", "jaxcompat", "manifest", "profiling")
 
 
 def __getattr__(name: str):

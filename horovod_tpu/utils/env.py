@@ -22,6 +22,14 @@ aliases; the TPU-specific defaults differ where the hardware does:
   (default 300; read in core/src/controller.cc): both the worker connect
   retry and the coordinator accept quorum share it, so a dead peer becomes
   an error on every rank instead of a hang.
+* ``HVD_TPU_STALL_ABORT_SECONDS`` — stall escalation (warn -> abort): when
+  set > 0 and a tensor has been pending longer, the coordinator aborts the
+  job with the restartable exit code (default 75, EX_TEMPFAIL; override
+  with ``HVD_TPU_STALL_ABORT_EXIT_CODE``) so ``python -m horovod_tpu.run
+  --max-restarts N`` relaunches instead of the job hanging forever
+  (docs/fault_tolerance.md).  0/unset keeps the warn-only reference
+  behaviour.
+* ``HVD_TPU_FAULT_*`` — deterministic fault injection (faults.py).
 """
 
 from __future__ import annotations
@@ -65,6 +73,23 @@ def stall_warning_seconds() -> float:
     exposed as a knob here mainly so tests can shrink it."""
     raw = _get("STALL_WARNING_TIME")
     return float(raw) if raw else STALL_WARNING_TIME_SECONDS
+
+
+# Restartable abort (EX_TEMPFAIL): the launcher's supervision treats this
+# exit as "transient, relaunch me" — the stall escalation and any rank that
+# wants an explicit restart use it.
+STALL_ABORT_EXIT_CODE = 75
+
+
+def stall_abort_seconds() -> float:
+    """Stall warn->abort escalation threshold; 0 (default) disables."""
+    raw = _get("STALL_ABORT_SECONDS")
+    return float(raw) if raw else 0.0
+
+
+def stall_abort_exit_code() -> int:
+    raw = _get("STALL_ABORT_EXIT_CODE")
+    return int(raw) if raw else STALL_ABORT_EXIT_CODE
 
 
 def hierarchical_allreduce() -> bool:

@@ -1,0 +1,104 @@
+"""Version-skew shims for the pinned jax/jaxlib in this image.
+
+The codebase targets the current jax surface; the image pins an older
+release.  Rather than scattering try/except at every call site, the
+differences are bridged here once, applied idempotently by the modules
+that need them (ops/collective_ops.py, core/device_reduce.py,
+basics.init) — the "stub or gate missing deps" rule:
+
+* ``jax.shard_map`` — promoted to the ``jax`` namespace upstream; older
+  releases only have ``jax.experimental.shard_map.shard_map``, whose
+  replication-check kwarg is spelled ``check_rep`` instead of
+  ``check_vma``.
+* ``jax.experimental.pallas.tpu.CompilerParams`` — older releases spell
+  it ``TPUCompilerParams``.
+* ``jax.lax.axis_size`` — newer spelling of "bound mesh axis size inside
+  a trace"; the pinned release exposes it as ``jax.core.axis_frame``.
+* ``jax.lax.pcast`` — the varying-manual-axes (VMA) annotation.  The
+  pinned release predates the VMA type system entirely, so the marking
+  is semantically a no-op there: shimmed as identity.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+_installed = False
+
+
+def install() -> None:
+    """Install the shims (idempotent, cheap after the first call)."""
+    global _installed
+    if _installed:
+        return
+    _installed = True
+    import jax
+
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        @functools.wraps(_shard_map)
+        def shard_map(f, *args, **kwargs):
+            if "check_vma" in kwargs:
+                kwargs["check_rep"] = kwargs.pop("check_vma")
+            return _shard_map(f, *args, **kwargs)
+
+        jax.shard_map = shard_map
+
+    from jax import lax
+
+    if not hasattr(lax, "axis_size"):
+        def axis_size(axis_name):
+            if isinstance(axis_name, (tuple, list)):
+                n = 1
+                for a in axis_name:
+                    n *= jax.core.axis_frame(a)
+                return n
+            return jax.core.axis_frame(axis_name)
+
+        lax.axis_size = axis_size
+
+    if not hasattr(lax, "pcast"):
+        def pcast(x, *args, **kwargs):  # noqa: ARG001 - annotation only
+            return x
+
+        lax.pcast = pcast
+
+    if not hasattr(jax.tree, "leaves_with_path"):
+        from jax import tree_util as _jtu
+
+        jax.tree.leaves_with_path = _jtu.tree_leaves_with_path
+
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+    except Exception:  # pragma: no cover - pallas absent entirely
+        pltpu = None
+    if pltpu is not None and not hasattr(pltpu, "CompilerParams") \
+            and hasattr(pltpu, "TPUCompilerParams"):
+        pltpu.CompilerParams = pltpu.TPUCompilerParams
+
+
+def enable_cpu_multiprocess_collectives() -> None:
+    """Select the gloo CPU-collectives backend for multi-process CPU jobs.
+
+    The pinned jaxlib's default CPU client has NO cross-process collective
+    implementation ("Multiprocess computations aren't implemented on the
+    CPU backend") — the launcher's -np N simulation and multi-host CPU
+    eager collectives need ``jax_cpu_collectives_implementation=gloo``.
+    Must run before the backend initializes; call from ``hvd.init()``
+    (basics.py) when a distributed CPU job is forming.  No-op when the
+    knob or gloo build is absent, or the user already chose one."""
+    import jax
+
+    if os.environ.get("JAX_CPU_COLLECTIVES_IMPLEMENTATION"):
+        return  # explicit user choice wins
+    try:
+        current = jax.config.read("jax_cpu_collectives_implementation")
+    except Exception:
+        return
+    if current in (None, "none"):
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # backend already up or gloo unavailable
+            pass

@@ -1,0 +1,105 @@
+"""The consolidated retry policy (utils/backoff.py) and the checkpoint
+completeness manifest (utils/manifest.py) — both jax-free by contract:
+the launcher parent and freshly spawned ranks use them before any backend
+import."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_modules_stay_jax_free():
+    # Enforced in a child interpreter: importing the supervision-side
+    # modules (launcher included) must not drag jax in.
+    code = (
+        "import sys\n"
+        "from horovod_tpu.utils import backoff, manifest\n"
+        "from horovod_tpu import faults\n"
+        "import horovod_tpu.run\n"
+        "assert 'jax' not in sys.modules, sorted(m for m in sys.modules"
+        " if m.startswith('jax'))[:5]\n"
+        "print('CLEAN')\n")
+    out = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                         capture_output=True, text=True, timeout=60,
+                         env={**os.environ, "PYTHONPATH": REPO})
+    assert out.returncode == 0, out.stderr
+    assert "CLEAN" in out.stdout
+
+
+def test_backoff_schedule_bounded_and_jittered():
+    from horovod_tpu.utils.backoff import Backoff
+
+    plain = Backoff(initial_s=0.1, max_s=1.0, jitter=False)
+    assert [plain.delay(k) for k in range(6)] == [
+        0.1, 0.2, 0.4, 0.8, 1.0, 1.0]
+    jit = Backoff(initial_s=0.1, max_s=1.0, seed=7)
+    for k, base in enumerate([0.1, 0.2, 0.4, 0.8, 1.0]):
+        d = jit.delay(k)
+        assert base / 2 <= d <= base, (k, d)
+
+
+def test_backoff_rejects_bad_policy():
+    from horovod_tpu.utils.backoff import Backoff
+
+    with pytest.raises(ValueError):
+        Backoff(initial_s=0)
+    with pytest.raises(ValueError):
+        Backoff(initial_s=1.0, max_s=0.5)
+
+
+def test_retry_until_success_then_deadline():
+    from horovod_tpu.utils.backoff import retry
+
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    slept = []
+    assert retry(flaky, deadline_s=10, initial_s=0.01,
+                 sleep=slept.append) == "ok"
+    assert len(calls) == 3 and len(slept) == 2
+
+    # Deadline exhausted: the LAST real exception propagates.
+    t = iter(range(100))
+
+    def always():
+        raise OSError("permanent")
+
+    with pytest.raises(OSError, match="permanent"):
+        retry(always, deadline_s=3, initial_s=0.01,
+              sleep=lambda _s: None, clock=lambda: float(next(t)))
+
+
+def test_manifest_commit_protocol(tmp_path):
+    from horovod_tpu.utils import manifest
+
+    root = tmp_path / "ck"
+    # Torn checkpoint (no commit file) is invisible.
+    os.makedirs(manifest.step_dir(root, 4))
+    assert manifest.complete_steps(root) == []
+    assert manifest.latest_complete(root) is None
+    # Committed steps are ordered; metadata round-trips.
+    for s in (2, 10):
+        os.makedirs(manifest.step_dir(root, s))
+        manifest.write_commit(manifest.step_dir(root, s), s,
+                              {"rng": [1, 2], "step": s})
+    assert manifest.complete_steps(root) == [2, 10]
+    step, path = manifest.latest_complete(root)
+    assert step == 10 and path.endswith("step_10")
+    doc = manifest.read_commit(path)
+    assert doc["step"] == 10 and doc["metadata"]["rng"] == [1, 2]
+    # Foreign entries are ignored; a garbled manifest reads as None.
+    os.makedirs(root / "notes", exist_ok=True)
+    with open(os.path.join(manifest.step_dir(root, 2),
+                           manifest.COMMIT_FILE), "w") as f:
+        f.write("{broken")
+    assert manifest.read_commit(manifest.step_dir(root, 2)) is None
+    assert manifest.complete_steps(root) == [2, 10]  # presence, not parse

@@ -1,0 +1,158 @@
+"""End-to-end elastic supervision: kill a rank mid-training, restart from
+the last complete checkpoint, finish with bit-identical parameters
+(docs/fault_tolerance.md; the elastic/torchrun lineage adapted to the
+synchronous SPMD world).
+
+The training script is deliberately tiny but REAL: `hvd.init()` forms the
+jax.distributed cluster, every step does an eager engine allreduce over
+the TCP control plane, and checkpoints flow through the manifest-committed
+CheckpointManager — the exact production path, minus the model size.
+Gradients are small integers in float32, so "bit-identical" holds with no
+tolerance games.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+from _timing import scaled
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# argv: ckpt_dir num_steps [step_sleep_s]
+TRAIN_SCRIPT = textwrap.dedent("""
+    import sys, time
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu import checkpoint, training
+
+    hvd.init()
+    ckpt_dir, steps = sys.argv[1], int(sys.argv[2])
+    step_sleep = float(sys.argv[3]) if len(sys.argv) > 3 else 0.0
+    mgr = checkpoint.CheckpointManager(ckpt_dir, max_to_keep=2)
+
+    def step_fn(step, state):
+        if step_sleep:
+            time.sleep(step_sleep)
+        grad = np.full(4, float((step + 1) * (hvd.rank() + 1)), np.float32)
+        h = hvd.allreduce_async(grad, average=False, name=f"elastic.g{step}")
+        g = hvd.synchronize(h)
+        print(f"STEP {step} rank={hvd.rank()}", flush=True)
+        return {"params": state["params"] + g}
+
+    state = {"params": np.zeros(4, np.float32)}
+    state = training.elastic_loop(step_fn, state, num_steps=steps,
+                                  manager=mgr, checkpoint_every=1)
+    print(f"[rank {hvd.rank()}] FINAL={state['params'].tolist()}", flush=True)
+""")
+
+
+def _launch(np_, *args, extra_env=None, timeout=None, launcher_flags=()):
+    env = {**os.environ, "PYTHONPATH": REPO,
+           "HVD_TPU_RESTART_BACKOFF": "0.1"}
+    env.pop("JAX_PLATFORMS", None)  # launcher pins cpu for children
+    env.update(extra_env or {})
+    return subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", str(np_),
+         *launcher_flags, "--", sys.executable, "-c", TRAIN_SCRIPT,
+         *[str(a) for a in args]],
+        cwd=REPO, capture_output=True, text=True,
+        timeout=timeout or scaled(240), env=env)
+
+
+def _finals(stdout: str) -> dict[int, str]:
+    out = {}
+    for line in stdout.splitlines():
+        if "FINAL=" in line:
+            rank = int(line.split("[rank ", 1)[1].split("]")[0])
+            out[rank] = line.split("FINAL=", 1)[1].strip()
+    return out
+
+
+def _expected_final(steps: int, np_: int) -> list[float]:
+    # Each step's allreduce sums (step+1)*(rank+1) over ranks.
+    total = sum((s + 1) * sum(r + 1 for r in range(np_))
+                for s in range(steps))
+    return [float(total)] * 4
+
+
+def test_kill_rank_mid_training_restart_resumes_bit_exact(tmp_path):
+    """The acceptance scenario: rank 1 is SIGKILLed at step 3 on attempt 0;
+    the launcher tears the job down (mpirun contract), relaunches, the
+    loop resumes from the step-2 checkpoint, and the final parameters
+    equal an uninterrupted run's exactly."""
+    steps, np_ = 6, 2
+
+    # Uninterrupted reference run.
+    clean = _launch(np_, tmp_path / "clean", steps)
+    assert clean.returncode == 0, clean.stdout[-3000:] + clean.stderr[-2000:]
+    clean_finals = _finals(clean.stdout)
+    assert set(clean_finals) == {0, 1}
+    assert clean_finals[0] == clean_finals[1]
+    assert clean_finals[0] == str(_expected_final(steps, np_))
+
+    # Faulted run under supervision.
+    faulted = _launch(
+        np_, tmp_path / "faulted", steps,
+        launcher_flags=("--max-restarts", "2",
+                        "--ckpt-dir", str(tmp_path / "faulted")),
+        extra_env={"HVD_TPU_FAULT_KILL_RANK": "1",
+                   "HVD_TPU_FAULT_KILL_STEP": "3"})
+    assert faulted.returncode == 0, \
+        faulted.stdout[-3000:] + faulted.stderr[-2000:]
+    assert "killing rank 1 at step 3" in faulted.stdout \
+        or "killing rank 1 at step 3" in faulted.stderr, faulted.stderr
+    assert "restarting (attempt 1" in faulted.stderr, faulted.stderr[-2000:]
+    assert "from checkpoint" in faulted.stderr, faulted.stderr[-2000:]
+    finals = _finals(faulted.stdout)
+    assert set(finals) == {0, 1}, faulted.stdout[-3000:]
+    # Bit-identical to the uninterrupted run on every rank.
+    assert finals[0] == clean_finals[0], (finals, clean_finals)
+    assert finals[1] == clean_finals[1]
+    # And the job genuinely resumed (step 3 ran twice at most, step 0 once
+    # per attempt 0 only): attempt 1 must not replay step 0.
+    attempt1 = faulted.stdout.split("restart", 1)[-1]
+    assert "STEP 0 rank=0" not in attempt1.split("STEP 3", 1)[-1]
+
+
+def test_sigterm_drains_complete_checkpoint_and_exits_clean(tmp_path):
+    """SIGTERM to the launcher: ranks get the forwarded signal, the loop
+    drains one complete checkpoint and everyone exits 0 within the drain
+    window (the preemption contract)."""
+    from horovod_tpu.utils import manifest
+
+    ckpt = tmp_path / "drain"
+    env = {**os.environ, "PYTHONPATH": REPO,
+           "HVD_TPU_RESTART_BACKOFF": "0.1"}
+    env.pop("JAX_PLATFORMS", None)
+    p = subprocess.Popen(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+         "--drain-secs", "60", "--",
+         sys.executable, "-c", TRAIN_SCRIPT, str(ckpt), "500", "0.2"],
+        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, env=env)
+    try:
+        saw_step = False
+        deadline = time.monotonic() + scaled(180)
+        lines = []
+        for line in p.stdout:
+            lines.append(line)
+            if "STEP 2 rank=0" in line:
+                saw_step = True
+                break
+            assert time.monotonic() < deadline, "".join(lines[-50:])
+        assert saw_step
+        p.send_signal(signal.SIGTERM)
+        rc = p.wait(timeout=scaled(120))
+        rest = p.stdout.read()
+        assert rc == 0, "".join(lines[-30:]) + rest[-2000:]
+    finally:
+        if p.poll() is None:
+            p.kill()
+    # A COMPLETE checkpoint landed (manifest-committed, not torn).
+    latest = manifest.latest_complete(ckpt)
+    assert latest is not None, os.listdir(ckpt)
+    assert manifest.is_complete(latest[1])

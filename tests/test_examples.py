@@ -46,14 +46,10 @@ def _final_metrics(out: str, np_: int = 2) -> dict[int, str]:
 def _run(script, *args, timeout=420):
     env = {
         **os.environ,
-        # The CPU backend's collective rendezvous hard-aborts the process
-        # after 40 s if a device thread lags (rendezvous.cc "Termination
-        # timeout").  8 virtual devices oversubscribing a small CI host
-        # while another program compiles can legitimately exceed that —
-        # give the simulation slack instead of flaking.
-        "XLA_FLAGS": "--xla_force_host_platform_device_count=8"
-                     " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
-                     " --xla_cpu_collective_call_terminate_timeout_seconds=600",
+        # Only the device-count flag: this image's jaxlib rejects the
+        # --xla_cpu_collective_call_* timeout flags (unknown XLA flags are a
+        # process abort, parse_flags_from_env.cc).
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
         "JAX_PLATFORMS": "cpu",
         "PYTHONPATH": REPO,
     }
@@ -214,6 +210,35 @@ def test_jax_mnist_advanced_np2():
     assert "finished gradual learning rate warmup" in out
     vals = _final_metrics(out)
     assert vals[0] == vals[1], vals
+
+
+def test_jax_mnist_fault_injected_restart(tmp_path):
+    """Faults-enabled smoke of the flagship example (docs/fault_tolerance.md):
+    the injector kills rank 0 mid-epoch-1, the supervisor relaunches, the
+    run resumes from the epoch-0 checkpoint and completes."""
+    ck = str(tmp_path / "elastic_ck")
+    env = {**os.environ, "PYTHONPATH": REPO,
+           "HVD_TPU_RESTART_BACKOFF": "0.1",
+           # Pin the worker's virtual chip count so the batch math is
+           # stable: 4096 samples / (64 × 8 chips) = 8 batches per epoch;
+           # step 10 is inside epoch 1, after the epoch-0 checkpoint
+           # committed.
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+           "HVD_TPU_FAULT_KILL_RANK": "0",
+           "HVD_TPU_FAULT_KILL_STEP": "10"}
+    env.pop("JAX_PLATFORMS", None)
+    out = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "1",
+         "--max-restarts", "1", "--ckpt-dir", ck, "--",
+         sys.executable, os.path.join(REPO, "examples", "jax_mnist.py"),
+         "--epochs", "2", "--batch-size", "64", "--ckpt-dir", ck],
+        capture_output=True, text=True, timeout=scaled(420), env=env,
+        cwd=REPO)
+    assert out.returncode == 0, (out.stdout[-3000:], out.stderr[-2000:])
+    assert "killing rank 0 at step 10" in out.stdout + out.stderr
+    assert "restarting (attempt 1" in out.stderr, out.stderr[-1500:]
+    assert "resumed from epoch 0" in out.stdout, out.stdout[-2500:]
+    assert "epoch 1:" in out.stdout
 
 
 @pytest.mark.slow

@@ -375,9 +375,9 @@ def test_tiered_int8_on_hierarchical_mesh(hvd):
 
 _WIDTH32_SCRIPT = r"""
 import os
-os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=32"
-                           " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
-                           " --xla_cpu_collective_call_terminate_timeout_seconds=600")
+# Device-count flag only: the pinned jaxlib aborts on unknown XLA flags
+# (the --xla_cpu_collective_call_* timeouts postdate it).
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=32"
 os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
 jax.config.update("jax_platforms", "cpu")

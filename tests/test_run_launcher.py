@@ -65,3 +65,100 @@ def test_rejects_hosts_flag():
         cwd=REPO, capture_output=True, text=True, timeout=scaled(60))
     assert res.returncode != 0
     assert "pod runtime" in res.stderr
+
+
+# ---------------------------------------------------------------------------
+# Supervision: restarts, crash-loop breaker, process-group signal forwarding
+# (docs/fault_tolerance.md).  Children are jax-free so these stay cheap.
+# ---------------------------------------------------------------------------
+
+# Fails on the first attempt, succeeds after the supervisor relaunches —
+# HVD_TPU_RESTART_ATTEMPT is the launcher-exported attempt counter.
+FLAKY_SCRIPT = textwrap.dedent("""
+    import os, sys
+    attempt = int(os.environ.get("HVD_TPU_RESTART_ATTEMPT", "0"))
+    print(f"ATTEMPT={attempt}", flush=True)
+    sys.exit(7 if attempt == 0 else 0)
+""")
+
+ALWAYS_FAIL_SCRIPT = "import sys; sys.exit(9)"
+
+# Spawns a grandchild, reports its pid, then lingers: SIGTERM to the
+# launcher must reap the WHOLE process group, grandchild included.
+GRANDCHILD_SCRIPT = textwrap.dedent("""
+    import subprocess, sys, time
+    p = subprocess.Popen([sys.executable, "-c", "import time; time.sleep(300)"])
+    print(f"GRANDCHILD={p.pid}", flush=True)
+    for _ in range(1200):
+        time.sleep(0.25)
+""")
+
+
+def _supervised(np_, script, *flags, timeout):
+    env = {**os.environ, "HVD_TPU_RESTART_BACKOFF": "0.05"}
+    return subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", str(np_), *flags,
+         "--", sys.executable, "-c", script],
+        cwd=REPO, capture_output=True, text=True, timeout=timeout, env=env)
+
+
+def test_restart_recovers_flaky_job():
+    res = _supervised(2, FLAKY_SCRIPT, "--max-restarts", "2",
+                      timeout=scaled(60))
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "ATTEMPT=0" in res.stdout and "ATTEMPT=1" in res.stdout
+    assert "restarting (attempt 1" in res.stderr, res.stderr
+
+
+def test_restart_budget_exhausts_with_original_code():
+    res = _supervised(1, ALWAYS_FAIL_SCRIPT, "--max-restarts", "1",
+                      timeout=scaled(60))
+    assert res.returncode == 9, res.stdout + res.stderr
+    assert "restart budget exhausted" in res.stderr, res.stderr
+    # Exactly one restart was attempted before giving up.
+    assert res.stderr.count("restarting (attempt") == 1, res.stderr
+
+
+def test_no_restart_by_default():
+    res = _supervised(1, ALWAYS_FAIL_SCRIPT, timeout=scaled(60))
+    assert res.returncode == 9
+    assert "restarting" not in res.stderr
+
+
+def test_sigterm_reaps_grandchildren():
+    import signal
+    import time
+
+    env = {**os.environ}
+    p = subprocess.Popen(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "1", "--",
+         sys.executable, "-c", GRANDCHILD_SCRIPT],
+        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, env=env)
+    try:
+        gpid = None
+        deadline = time.monotonic() + scaled(30)
+        for line in p.stdout:
+            if "GRANDCHILD=" in line:
+                gpid = int(line.rsplit("=", 1)[1])
+                break
+            assert time.monotonic() < deadline, "no grandchild line"
+        assert gpid is not None
+        p.send_signal(signal.SIGTERM)
+        p.wait(timeout=scaled(30))
+        # The grandchild must be gone: SIGTERM was forwarded to the whole
+        # process group (os.killpg), so a preempted supervisor cannot
+        # orphan worker subprocesses.
+        deadline = time.monotonic() + scaled(10)
+        while time.monotonic() < deadline:
+            try:
+                os.kill(gpid, 0)
+            except ProcessLookupError:
+                break
+            time.sleep(0.1)
+        else:
+            os.kill(gpid, 9)
+            raise AssertionError(f"grandchild {gpid} survived the drain")
+    finally:
+        if p.poll() is None:
+            p.kill()

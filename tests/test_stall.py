@@ -1,13 +1,19 @@
 """Stall detection: rank 0 must warn about tensors stuck waiting for
 missing ranks (reference CheckForStalledTensors, operations.cc:1366-1412,
-60 s window; shrunk here via HOROVOD_STALL_WARNING_TIME)."""
+60 s window; shrunk here via HOROVOD_STALL_WARNING_TIME) — plus the two
+TPU-rebuild extensions: the structured ``stall_report()`` surface and the
+warn -> abort escalation (``HVD_TPU_STALL_ABORT_SECONDS``) that turns a
+deadlocked job into a restartable exit instead of a hang."""
 
+import os
 import socket
 import subprocess
 import sys
 import textwrap
 
 from _timing import scaled
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _free_port() -> int:
@@ -33,21 +39,43 @@ SCRIPT = textwrap.dedent("""
         eng.enqueue("lonely", np.ones(4, np.float32), OP_ALLREDUCE)
     time.sleep(1.2)
     print("ALIVE", flush=True)
+    if rank == 0:
+        print("REPORT", eng.stall_report(), flush=True)
     eng._shutdown.set()   # skip graceful shutdown: peer may already be gone
 """)
 
+# Deliberately-deadlocked job under the escalation: rank 0's engine must
+# _Exit the process with the restartable code, never run out this loop
+# (bounded 0.25 s naps, ~10 s total worst case — no long sleeps).
+ABORT_SCRIPT = textwrap.dedent("""
+    import sys, time
+    import numpy as np
+    from horovod_tpu.core.engine import NativeEngine, OP_ALLREDUCE
+    from horovod_tpu.core.executors import local_executor
 
-def test_stall_warning():
-    port = _free_port()
-    env = {"HOROVOD_STALL_WARNING_TIME": "0.3", "PYTHONPATH": "."}
-    import os
+    rank, port = int(sys.argv[1]), int(sys.argv[2])
+    eng = NativeEngine(rank, 2, executor=local_executor,
+                       coordinator_host="127.0.0.1", coordinator_port=port,
+                       cycle_time_ms=2.0)
+    if rank == 0:
+        eng.enqueue("wedged", np.ones(4, np.float32), OP_ALLREDUCE)
+        for _ in range(40):
+            time.sleep(0.25)
+        print("SURVIVED", flush=True)   # must never be reached on rank 0
+    else:
+        # Outlive the coordinator's abort so the job's death is rank 0's.
+        for _ in range(8):
+            time.sleep(0.25)
+    eng._shutdown.set()
+""")
 
-    env = {**os.environ, **env}
+
+def _run_pair(script, port, extra_env):
+    env = {**os.environ, "PYTHONPATH": ".", **extra_env}
     procs = [
-        subprocess.Popen([sys.executable, "-c", SCRIPT, str(r), str(port)],
+        subprocess.Popen([sys.executable, "-c", script, str(r), str(port)],
                          stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-                         env=env, text=True, cwd=os.path.dirname(
-                             os.path.dirname(os.path.abspath(__file__))))
+                         env=env, text=True, cwd=REPO)
         for r in range(2)
     ]
     try:
@@ -56,8 +84,28 @@ def test_stall_warning():
         for p in procs:
             p.kill()
         raise
+    return procs, outs
+
+
+def test_stall_warning_and_report():
+    procs, outs = _run_pair(SCRIPT, _free_port(),
+                            {"HOROVOD_STALL_WARNING_TIME": "0.3"})
     assert "ALIVE" in outs[0][0]
     assert "ALIVE" in outs[1][0]
     stderr0 = outs[0][1]
     assert "Stalled op: lonely" in stderr0, stderr0
     assert "missing ranks: 1" in stderr0, stderr0
+    # Structured surface of the same condition (hvd.stall_report()).
+    assert "REPORT [('lonely', [1])]" in outs[0][0], outs[0][0]
+
+
+def test_stall_escalates_to_restartable_abort():
+    procs, outs = _run_pair(
+        ABORT_SCRIPT, _free_port(),
+        {"HOROVOD_STALL_WARNING_TIME": "0.2",
+         "HVD_TPU_STALL_ABORT_SECONDS": "0.6"})
+    # The coordinator aborts the deadlocked job with the distinct
+    # restartable exit code (75 = EX_TEMPFAIL) instead of hanging.
+    assert procs[0].returncode == 75, (procs[0].returncode, outs[0])
+    assert "SURVIVED" not in outs[0][0]
+    assert "HVD_TPU_STALL_ABORT_SECONDS" in outs[0][1], outs[0][1]
