@@ -42,6 +42,7 @@ for _mod, _names in {
         "num_chips", "rank", "shutdown", "size", "stall_report",
         "subset_active",
     ),
+    "horovod_tpu.analysis.schedule": ("divergence_report",),
     "horovod_tpu.core.engine": ("CollectiveError",),
     "horovod_tpu.mesh": (
         "DATA_AXIS", "data_sharding", "data_spec", "global_mesh",

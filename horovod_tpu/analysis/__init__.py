@@ -1,0 +1,47 @@
+"""Correctness tooling for the collective contract.
+
+The whole design rests on one invariant: every rank issues the same
+collectives in the same program order (SURVEY §7, ops/collective_ops.py).
+Nothing in the runtime can *prevent* a violation — the stall detector
+(core/src/controller.cc) only reports the resulting hang.  This package
+closes the gap from both ends:
+
+* :mod:`horovod_tpu.analysis.lint` — ``hvd-lint``, an AST-based static
+  analyzer (``python -m horovod_tpu.analysis.lint <paths>``) that rejects
+  rank-divergent collective call sites, unnamed collectives in loops,
+  nondeterministically-named collectives, impure jitted step functions,
+  and unknown mesh axis names before a job ever launches
+  (docs/static_analysis.md has the rule catalog).
+* :mod:`horovod_tpu.analysis.schedule` — the runtime schedule verifier:
+  under ``HVD_TPU_VERIFY_SCHEDULE=1`` every submitted collective extends a
+  per-rank rolling hash that the native coordinator cross-checks across
+  ranks every few ticks, turning a divergent schedule into an immediate
+  coordinated abort with a structured report (``hvd.divergence_report()``)
+  instead of a stall-timeout hang.
+"""
+
+import importlib
+
+# Lazy (PEP 562), matching the package root: `python -m
+# horovod_tpu.analysis.lint` must not import the lint module twice (runpy
+# warns), and importing the package must stay stdlib-cheap.
+_ATTR_HOME = {
+    "LintError": "horovod_tpu.analysis.lint",
+    "lint_paths": "horovod_tpu.analysis.lint",
+    "lint_source": "horovod_tpu.analysis.lint",
+    "divergence_report": "horovod_tpu.analysis.schedule",
+    "verify_enabled": "horovod_tpu.analysis.schedule",
+    "verify_interval_ticks": "horovod_tpu.analysis.schedule",
+}
+
+__all__ = sorted(_ATTR_HOME)
+
+
+def __getattr__(name: str):
+    home = _ATTR_HOME.get(name)
+    if home is None:
+        raise AttributeError(
+            f"module 'horovod_tpu.analysis' has no attribute {name!r}")
+    value = getattr(importlib.import_module(home), name)
+    globals()[name] = value
+    return value

@@ -40,6 +40,9 @@ _LIB_PATH = os.path.join(_HERE, os.environ.get("HVD_CORE_LIB",
 
 # Wire enums — must match core/src/common.h and message.h.
 OP_ALLREDUCE, OP_ALLGATHER, OP_BROADCAST, OP_ALLTOALL, OP_BARRIER = range(5)
+OP_NAMES = {OP_ALLREDUCE: "allreduce", OP_ALLGATHER: "allgather",
+            OP_BROADCAST: "broadcast", OP_ALLTOALL: "alltoall",
+            OP_BARRIER: "barrier"}
 
 # Wire formats (core/src/message.h WireFormat): NATIVE ships the tensor's
 # own dtype; INT8 ships (f32 scale, int8 values) per rank — allreduce only.
@@ -93,6 +96,7 @@ def _load_library() -> ctypes.CDLL:
     lib.hvd_create.argtypes = [
         ctypes.c_int, ctypes.c_int, ctypes.c_double, ctypes.c_longlong,
         ctypes.c_double, ctypes.c_int, ctypes.c_double, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int,
         ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
     lib.hvd_start.restype = ctypes.c_int
     lib.hvd_start.argtypes = [ctypes.c_void_p,
@@ -116,6 +120,12 @@ def _load_library() -> ctypes.CDLL:
     lib.hvd_stall_report.restype = ctypes.c_int
     lib.hvd_stall_report.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                      ctypes.c_int]
+    lib.hvd_verify_submit.restype = None
+    lib.hvd_verify_submit.argtypes = [ctypes.c_void_p, ctypes.c_longlong,
+                                      ctypes.c_ulonglong, ctypes.c_char_p]
+    lib.hvd_divergence_report.restype = ctypes.c_int
+    lib.hvd_divergence_report.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                          ctypes.c_int]
     lib.hvd_poll.restype = ctypes.c_int
     lib.hvd_poll.argtypes = [ctypes.c_void_p, ctypes.c_longlong]
     lib.hvd_wait.restype = ctypes.c_int
@@ -221,6 +231,9 @@ class NativeEngine:
         # engine-wide mutex) entirely on untimed runs — the common case.
         # Single source of truth: hvd_create's timeline arg derives from it.
         self._timeline_enabled = bool(tl) and rank == 0
+        # Cached once: enqueue is on the submission hot path and the
+        # verifier is a debug mode (HVD_TPU_VERIFY_SCHEDULE).
+        self._verify_enabled = env.verify_schedule()
         self._ptr = self._lib.hvd_create(
             rank, size,
             cycle_time_ms if cycle_time_ms is not None else env.cycle_time_ms(),
@@ -229,6 +242,8 @@ class NativeEngine:
             0 if env.stall_check_disabled() else 1,
             env.stall_abort_seconds(),
             env.stall_abort_exit_code(),
+            1 if self._verify_enabled else 0,
+            env.verify_interval_ticks(),
             tl.encode() if self._timeline_enabled else None,
             (coordinator_host or "127.0.0.1").encode(),
             coordinator_port)
@@ -265,8 +280,12 @@ class NativeEngine:
                 # the same rule for the window after execution started
                 # (reference operations.cc:2035-2040).
                 raise CollectiveError(
-                    f"Duplicate tensor name {name}; a previous request for "
-                    f"this tensor has not completed.")
+                    f"Duplicate tensor name '{name}' for "
+                    f"{OP_NAMES.get(op, op)}: a previous request with this "
+                    f"name has not completed. Collectives submitted in a "
+                    f"loop need an explicit, per-iteration name= kwarg "
+                    f"(e.g. name=f'grad.{{step}}.{{param}}') — hvd-lint "
+                    f"rule HVD102, docs/static_analysis.md.")
             self._store[name] = arr
         h = self._lib.hvd_enqueue(self._ptr, name.encode(), op, dtype_id,
                                   dims, arr.ndim, root_rank, wire, err, 512)
@@ -276,7 +295,68 @@ class NativeEngine:
             raise CollectiveError(err.value.decode())
         with self._store_lock:
             self._handle_names[int(h)] = (name, arr)
+        if self._verify_enabled:
+            self._record_verify(op, name, arr)
         return int(h)
+
+    # -- schedule verifier (HVD_TPU_VERIFY_SCHEDULE; analysis/schedule.py) --
+
+    def _record_verify(self, op: int, name: str, arr: np.ndarray) -> None:
+        from horovod_tpu.analysis import schedule
+
+        schedule.record_entry(OP_NAMES.get(op, str(op)), name,
+                              arr.dtype.name, arr.shape)
+        self.flush_verify()
+
+    def flush_verify(self) -> None:
+        """Deliver recorded schedule checkpoints (including any buffered
+        before this engine started, e.g. compiled-path traces) to the
+        native coordinator stream."""
+        from horovod_tpu.analysis import schedule
+
+        for seq, h, desc in schedule.recorder().drain():
+            self.verify_submit(seq, h, desc)
+
+    def verify_submit(self, seq: int, hash_: int, desc: str) -> None:
+        self._lib.hvd_verify_submit(self._ptr, seq, hash_, desc.encode())
+
+    def divergence_report(self) -> list[tuple[int, int, str]]:
+        """Structured schedule-divergence view: ``[(rank, seq, op_desc),
+        ...]`` — each rank's first mismatched collective once the verifier
+        tripped; [] while the schedule is consistent.  The divergence
+        analog of :meth:`stall_report`."""
+        buf = ctypes.create_string_buffer(1 << 16)
+        n = self._lib.hvd_divergence_report(self._ptr, buf, len(buf))
+        if n < -1:
+            buf = ctypes.create_string_buffer(-n + 16)
+            n = self._lib.hvd_divergence_report(self._ptr, buf, len(buf))
+        if n <= 0:
+            return []
+        raw = buf.raw[:n]
+        off = 0
+
+        def i32():
+            nonlocal off
+            v = struct.unpack_from("<i", raw, off)[0]
+            off += 4
+            return v
+
+        def i64():
+            nonlocal off
+            v = struct.unpack_from("<q", raw, off)[0]
+            off += 8
+            return v
+
+        out = []
+        for _ in range(i32()):
+            rank = i32()
+            seq = i64()
+            i64()  # rolling hash: internal detail, not surfaced
+            ln = i32()
+            desc = raw[off:off + ln].decode()
+            off += ln
+            out.append((rank, seq, desc))
+        return out
 
     def poll(self, handle: int) -> bool:
         return bool(self._lib.hvd_poll(self._ptr, handle))
@@ -415,6 +495,18 @@ def get_engine() -> NativeEngine:
             _engine = NativeEngine(basics.rank(), basics.size(),
                                    coordinator_host=host,
                                    coordinator_port=port)
+            if _engine._verify_enabled:
+                # Schedule checkpoints recorded before the engine existed
+                # (compiled-path traces during warmup) join the stream now.
+                _engine.flush_verify()
+        return _engine
+
+
+def peek_engine() -> NativeEngine | None:
+    """The running engine, or None — never starts one (the schedule
+    verifier and report helpers must not boot a control plane as a side
+    effect of asking a question)."""
+    with _engine_lock:
         return _engine
 
 

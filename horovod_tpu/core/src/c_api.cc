@@ -44,7 +44,8 @@ extern "C" {
 void* hvd_create(int rank, int size, double cycle_ms,
                  long long fusion_threshold, double stall_seconds,
                  int stall_check, double stall_abort_seconds,
-                 int stall_abort_exit_code, const char* timeline_path,
+                 int stall_abort_exit_code, int verify_schedule,
+                 int verify_interval_ticks, const char* timeline_path,
                  const char* coord_host, int coord_port) {
   EngineOptions opts;
   opts.rank = rank;
@@ -56,6 +57,10 @@ void* hvd_create(int rank, int size, double cycle_ms,
   opts.stall_abort_seconds = stall_abort_seconds;
   if (stall_abort_exit_code > 0) {
     opts.stall_abort_exit_code = stall_abort_exit_code;
+  }
+  opts.verify_schedule = verify_schedule != 0;
+  if (verify_interval_ticks > 0) {
+    opts.verify_interval_ticks = verify_interval_ticks;
   }
   if (timeline_path != nullptr) opts.timeline_path = timeline_path;
   if (coord_host != nullptr) opts.coordinator_host = coord_host;
@@ -145,6 +150,34 @@ int hvd_stall_report(void* e, char* buf, int buflen) {
     w.str(entry.name);
     w.i32(static_cast<int32_t>(entry.missing_ranks.size()));
     for (int r : entry.missing_ranks) w.i32(r);
+  }
+  if (static_cast<int>(w.buf.size()) > buflen) {
+    return -static_cast<int>(w.buf.size()) - 1;
+  }
+  std::memcpy(buf, w.buf.data(), w.buf.size());
+  return static_cast<int>(w.buf.size());
+}
+
+// Schedule-verifier intake (analysis/schedule.py): one call per collective
+// submission with the rank's sequence number, rolling hash, and a
+// description used in the divergence report.
+void hvd_verify_submit(void* e, long long seq, unsigned long long hash,
+                       const char* desc) {
+  static_cast<Engine*>(e)->SubmitVerify(seq, hash, desc ? desc : "");
+}
+
+// Serialized divergence report: i32 count, then per entry {i32 rank,
+// i64 seq, i64 hash, str desc}.  Returns bytes written, or -needed-1 when
+// buflen is too small (hvd_next_batch's grow-and-retry convention).
+int hvd_divergence_report(void* e, char* buf, int buflen) {
+  auto entries = static_cast<Engine*>(e)->DivergenceReport();
+  Writer w;
+  w.i32(static_cast<int32_t>(entries.size()));
+  for (const auto& entry : entries) {
+    w.i32(entry.rank);
+    w.i64(static_cast<int64_t>(entry.seq));
+    w.i64(static_cast<int64_t>(entry.hash));
+    w.str(entry.desc);
   }
   if (static_cast<int>(w.buf.size()) > buflen) {
     return -static_cast<int>(w.buf.size()) - 1;

@@ -374,7 +374,8 @@ Coordinator::Coordinator(int size, double stall_warning_seconds,
     : size_(size),
       stall_seconds_(stall_warning_seconds),
       stall_check_(stall_check),
-      last_stall_warn_(std::chrono::steady_clock::now()) {}
+      last_stall_warn_(std::chrono::steady_clock::now()),
+      verify_streams_(static_cast<size_t>(size)) {}
 
 void Coordinator::Ingest(const Request& req) {
   auto it = table_.find(req.name);
@@ -475,11 +476,56 @@ Response Coordinator::Finalize(const std::string& name) {
   return resp;
 }
 
+void Coordinator::IngestVerify(int rank,
+                               const std::vector<VerifyEntry>& entries) {
+  if (rank < 0 || rank >= size_) return;
+  auto& stream = verify_streams_[static_cast<size_t>(rank)];
+  for (const auto& e : entries) {
+    if (e.seq < verify_checked_) continue;  // already matched and pruned
+    stream.push_back(e);
+  }
+}
+
+std::vector<DivergenceEntry> Coordinator::CheckDivergence() {
+  if (!divergence_.empty()) return divergence_;  // sticky
+  for (;;) {
+    // One seq per pass: compare only when EVERY rank has reported it.
+    for (const auto& stream : verify_streams_) {
+      if (stream.empty() || stream.front().seq != verify_checked_) {
+        return {};
+      }
+    }
+    const uint64_t h0 = verify_streams_[0].front().hash;
+    bool match = true;
+    for (const auto& stream : verify_streams_) {
+      if (stream.front().hash != h0) match = false;
+    }
+    if (!match) {
+      for (int r = 0; r < size_; ++r) {
+        const VerifyEntry& e = verify_streams_[static_cast<size_t>(r)].front();
+        DivergenceEntry d;
+        d.rank = r;
+        d.seq = e.seq;
+        d.hash = e.hash;
+        d.desc = e.desc;
+        divergence_.push_back(std::move(d));
+      }
+      return divergence_;
+    }
+    for (auto& stream : verify_streams_) stream.pop_front();
+    ++verify_checked_;
+  }
+}
+
 ResponseList Coordinator::Tick(const std::vector<RequestList>& gathered) {
   ResponseList out;
-  for (const auto& list : gathered) {
+  for (size_t rank = 0; rank < gathered.size(); ++rank) {
+    const auto& list = gathered[rank];
     if (list.shutdown) out.shutdown = true;
     for (const auto& req : list.requests) Ingest(req);
+    if (!list.verify.empty()) {
+      IngestVerify(static_cast<int>(rank), list.verify);
+    }
   }
   // Emit ready tensors in first-announcement order; unready tensors remain.
   // IMPORTANT: even errored tensors wait for ALL ranks to announce — if the

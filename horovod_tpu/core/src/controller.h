@@ -12,6 +12,7 @@
 #pragma once
 
 #include <chrono>
+#include <deque>
 #include <map>
 #include <memory>
 #include <string>
@@ -132,10 +133,20 @@ class Coordinator {
   // drives the stall-abort escalation (engine.cc).
   double OldestPendingSeconds() const;
 
+  // Schedule verifier (HVD_TPU_VERIFY_SCHEDULE; analysis/schedule.py).
+  // Tick() ingests each rank's VerifyEntry stream; CheckDivergence()
+  // compares the rolling hashes seq-by-seq up to the highest sequence
+  // number every rank has reported.  Matching prefixes are pruned; the
+  // first mismatch returns one entry per rank naming that rank's
+  // collective at the diverging sequence number (sticky: later calls
+  // keep returning it).  Empty while schedules agree.
+  std::vector<DivergenceEntry> CheckDivergence();
+
   size_t pending() const { return table_.size(); }
 
  private:
   void Ingest(const Request& req);
+  void IngestVerify(int rank, const std::vector<VerifyEntry>& entries);
   Response Finalize(const std::string& name);
 
   int size_;
@@ -145,6 +156,11 @@ class Coordinator {
   std::unordered_map<std::string, TensorRecord> table_;
   std::vector<std::string> fifo_;      // names in first-announcement order
   std::chrono::steady_clock::time_point last_stall_warn_;
+  // Verifier state: per-rank checkpoint streams, contiguous from
+  // verify_checked_ (lower seqs already matched and were pruned).
+  std::vector<std::deque<VerifyEntry>> verify_streams_;
+  int64_t verify_checked_ = 0;
+  std::vector<DivergenceEntry> divergence_;  // sticky once detected
 };
 
 }  // namespace hvd

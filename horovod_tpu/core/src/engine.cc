@@ -4,8 +4,31 @@
 #include <chrono>
 #include <cstdio>
 #include <cstdlib>
+#include <sstream>
 
 namespace hvd {
+
+namespace {
+
+// Condition-variable waits go through wait_until against system_clock, NOT
+// wait_for: wait_for waits against steady_clock, which libstdc++ lowers to
+// pthread_cond_clockwait — a call gcc-10's libtsan does not intercept, so
+// the TSAN gate (make check) would miss the unlock inside every wait and
+// report phantom double-locks on mu_.  system_clock waits lower to the
+// intercepted pthread_cond_timedwait; the timeouts here are coarse polling
+// windows, so wall-clock jumps only stretch/shrink a poll interval.
+template <typename Pred>
+bool WaitWithTimeout(std::condition_variable& cv,
+                     std::unique_lock<std::mutex>& l, double timeout_ms,
+                     Pred pred) {
+  auto deadline =
+      std::chrono::system_clock::now() +
+      std::chrono::duration_cast<std::chrono::system_clock::duration>(
+          std::chrono::duration<double, std::milli>(timeout_ms));
+  return cv.wait_until(l, deadline, pred);
+}
+
+}  // namespace
 
 Engine::Engine(EngineOptions opts) : opts_(std::move(opts)) {}
 
@@ -59,8 +82,11 @@ int64_t Engine::Enqueue(const std::string& name, OpType op, DataType dtype,
     // (operations.cc:2035-2040): a second request for a name still in
     // flight is a client error, reported immediately.
     *status = Status::InvalidArgument(
-        "Duplicate tensor name " + name +
-        "; a previous request for this tensor has not completed.");
+        "Duplicate tensor name '" + name + "' for " +
+        std::string(OpTypeName(op)) +
+        ": a previous request with this name has not completed. "
+        "Collectives submitted in a loop need an explicit, per-iteration "
+        "name= kwarg (hvd-lint rule HVD102, docs/static_analysis.md).");
     return -1;
   }
   Request req;
@@ -101,6 +127,10 @@ void Engine::RunCycle() {
       own.requests.push_back(req);
     }
     pending_enqueues_.clear();
+    if (opts_.verify_schedule) {
+      own.verify = std::move(pending_verify_);
+      pending_verify_.clear();
+    }
   }
   own.shutdown = shutdown_requested_.load();
 
@@ -114,6 +144,10 @@ void Engine::RunCycle() {
       return;
     }
     responses = coordinator_->Tick(gathered);
+    if (opts_.verify_schedule &&
+        ++verify_tick_ % std::max(opts_.verify_interval_ticks, 1) == 0) {
+      responses.divergence = coordinator_->CheckDivergence();
+    }
     std::string stall = coordinator_->CheckStalled();
     if (!stall.empty()) {
       std::fprintf(stderr, "WARNING: %s", stall.c_str());
@@ -152,6 +186,14 @@ void Engine::RunCycle() {
       exec_cv_.notify_all();
       return;
     }
+  }
+
+  if (!responses.divergence.empty()) {
+    // Schedule divergence: the collectives in flight can never pair up
+    // across ranks again — fail everything NOW with the structured
+    // report instead of letting the job ride to the stall timeout.
+    HandleDivergence(responses.divergence);
+    return;
   }
 
   DispatchResponses(responses);
@@ -265,9 +307,9 @@ void Engine::DispatchResponses(const ResponseList& responses) {
 
 int Engine::NextBatch(ExecBatch* out, double timeout_ms) {
   std::unique_lock<std::mutex> l(mu_);
-  if (!exec_cv_.wait_for(
-          l, std::chrono::duration<double, std::milli>(timeout_ms),
-          [&] { return !exec_queue_.empty() || stopped_.load(); })) {
+  if (!WaitWithTimeout(exec_cv_, l, timeout_ms, [&] {
+        return !exec_queue_.empty() || stopped_.load();
+      })) {
     return 0;
   }
   if (!exec_queue_.empty()) {
@@ -310,6 +352,30 @@ void Engine::BatchDone(int64_t batch_id, const Status& status) {
   executing_.erase(it);
 }
 
+void Engine::HandleDivergence(const std::vector<DivergenceEntry>& entries) {
+  std::ostringstream msg;
+  msg << "Collective schedule divergence detected (HVD_TPU_VERIFY_SCHEDULE)"
+      << ": ranks submitted different collectives at sequence number "
+      << (entries.empty() ? int64_t{0} : entries[0].seq)
+      << ". First mismatched collective per rank:\n";
+  for (const auto& e : entries) {
+    msg << "  rank " << e.rank << ": " << e.desc << "\n";
+  }
+  msg << "Every rank must issue the same collectives in the same order; "
+         "run `python -m horovod_tpu.analysis.lint` on the training script "
+         "to find rank-divergent call sites.";
+  std::string text = msg.str();
+  std::fprintf(stderr, "ERROR: horovod_tpu %s\n", text.c_str());
+  std::fflush(stderr);
+  {
+    std::lock_guard<std::mutex> l(mu_);
+    divergence_ = entries;
+  }
+  FailAllPending(Status::PreconditionError(text));
+  stopped_.store(true);
+  exec_cv_.notify_all();
+}
+
 void Engine::FailAllPending(const Status& status) {
   std::lock_guard<std::mutex> l(mu_);
   for (auto& [handle, req] : pending_enqueues_) MarkDone(handle, status);
@@ -337,6 +403,19 @@ std::vector<StallEntry> Engine::StallReport() {
   return last_stall_;
 }
 
+void Engine::SubmitVerify(int64_t seq, uint64_t hash,
+                          const std::string& desc) {
+  if (!opts_.verify_schedule) return;
+  std::lock_guard<std::mutex> l(mu_);
+  if (stopped_.load() || shutdown_requested_.load()) return;
+  pending_verify_.push_back(VerifyEntry{seq, hash, desc});
+}
+
+std::vector<DivergenceEntry> Engine::DivergenceReport() {
+  std::lock_guard<std::mutex> l(mu_);
+  return divergence_;
+}
+
 bool Engine::PollHandle(int64_t handle) {
   std::lock_guard<std::mutex> l(mu_);
   auto it = handles_.find(handle);
@@ -345,11 +424,10 @@ bool Engine::PollHandle(int64_t handle) {
 
 bool Engine::WaitHandle(int64_t handle, double timeout_ms) {
   std::unique_lock<std::mutex> l(mu_);
-  return done_cv_.wait_for(
-      l, std::chrono::duration<double, std::milli>(timeout_ms), [&] {
-        auto it = handles_.find(handle);
-        return it == handles_.end() || it->second.done;
-      });
+  return WaitWithTimeout(done_cv_, l, timeout_ms, [&] {
+    auto it = handles_.find(handle);
+    return it == handles_.end() || it->second.done;
+  });
 }
 
 Status Engine::PeekHandle(int64_t handle) {

@@ -60,6 +60,13 @@ struct EngineOptions {
   // (HVD_TPU_STALL_ABORT_SECONDS; docs/fault_tolerance.md).
   double stall_abort_seconds = 0;
   int stall_abort_exit_code = 75;  // EX_TEMPFAIL: transient, retry me
+  // Schedule verifier (HVD_TPU_VERIFY_SCHEDULE, analysis/schedule.py):
+  // when on, the coordinator cross-checks per-rank rolling schedule
+  // hashes every verify_interval_ticks cycles and fails every pending
+  // collective with a structured divergence report on the first
+  // mismatch — instead of the job stalling until the stall timeout.
+  bool verify_schedule = false;
+  int verify_interval_ticks = 10;
   std::string timeline_path;      // empty = disabled
   std::string coordinator_host;   // workers (rank>0)
   int coordinator_port = 0;       // 0 = pick ephemeral (coordinator)
@@ -100,6 +107,17 @@ class Engine {
   // snapshot of the last cycle's view — hvd.stall_report() in Python.
   std::vector<StallEntry> StallReport();
 
+  // Schedule verifier intake: the Python layer reports each collective
+  // submission's (seq, rolling hash, description); forwarded to the
+  // coordinator with the next cycle's RequestList.  No-op when
+  // verify_schedule is off.
+  void SubmitVerify(int64_t seq, uint64_t hash, const std::string& desc);
+
+  // Structured divergence report (every rank once a divergence response
+  // arrived): each rank's first mismatched collective.  Empty while the
+  // schedule is consistent — hvd.divergence_report() in Python.
+  std::vector<DivergenceEntry> DivergenceReport();
+
   // Handle table (reference torch/handle_manager.{h,cc}).
   bool PollHandle(int64_t handle);                 // true = done
   // Block until the handle completes (condvar wait, not a poll loop).
@@ -115,6 +133,7 @@ class Engine {
   void Loop();
   void RunCycle();
   void DispatchResponses(const ResponseList& responses);
+  void HandleDivergence(const std::vector<DivergenceEntry>& entries);
   void FailAllPending(const Status& status);
   void MarkDone(int64_t handle, const Status& status);
 
@@ -138,6 +157,9 @@ class Engine {
   };
   std::unordered_map<int64_t, HandleState> handles_;
   std::vector<StallEntry> last_stall_;  // guarded by mu_
+  std::vector<VerifyEntry> pending_verify_;      // guarded by mu_
+  std::vector<DivergenceEntry> divergence_;      // guarded by mu_
+  int64_t verify_tick_ = 0;   // background thread only
   int64_t next_handle_ = 0;
   int64_t next_batch_id_ = 0;
 

@@ -33,6 +33,7 @@ struct Writer {
   void u8(uint8_t v) { out->push_back(static_cast<char>(v)); }
   void i32(int32_t v) { raw(&v, 4); }
   void i64(int64_t v) { raw(&v, 8); }
+  void u64(uint64_t v) { raw(&v, 8); }
   void raw(const void* p, size_t n) {
     out->append(reinterpret_cast<const char*>(p), n);
   }
@@ -57,6 +58,7 @@ struct Reader {
   uint8_t u8() { uint8_t v = 0; take(&v, 1); return v; }
   int32_t i32() { int32_t v = 0; take(&v, 4); return v; }
   int64_t i64() { int64_t v = 0; take(&v, 8); return v; }
+  uint64_t u64() { uint64_t v = 0; take(&v, 8); return v; }
   std::string str() {
     int32_t n = i32();
     if (fail || n < 0 || static_cast<size_t>(n) > kMaxString ||
@@ -87,6 +89,12 @@ void Serialize(const RequestList& in, std::string* out) {
     for (auto d : r.shape.dims) w.i64(d);
   }
   w.u8(in.shutdown ? 1 : 0);
+  w.i32(static_cast<int32_t>(in.verify.size()));
+  for (const auto& v : in.verify) {
+    w.i64(v.seq);
+    w.u64(v.hash);
+    w.str(v.desc);
+  }
 }
 
 bool Deserialize(const char* data, size_t len, RequestList* out) {
@@ -111,6 +119,18 @@ bool Deserialize(const char* data, size_t len, RequestList* out) {
     out->requests.push_back(std::move(q));
   }
   out->shutdown = r.u8() != 0;
+  int32_t nv = r.i32();
+  if (r.fail || nv < 0 || static_cast<size_t>(nv) > kMaxVector) return false;
+  out->verify.clear();
+  out->verify.reserve(nv);
+  for (int32_t i = 0; i < nv; ++i) {
+    VerifyEntry v;
+    v.seq = r.i64();
+    v.hash = r.u64();
+    v.desc = r.str();
+    if (r.fail) return false;
+    out->verify.push_back(std::move(v));
+  }
   return !r.fail;
 }
 
@@ -126,6 +146,13 @@ void Serialize(const ResponseList& in, std::string* out) {
     for (auto d : resp.first_dim_sizes) w.i64(d);
   }
   w.u8(in.shutdown ? 1 : 0);
+  w.i32(static_cast<int32_t>(in.divergence.size()));
+  for (const auto& d : in.divergence) {
+    w.i32(d.rank);
+    w.i64(d.seq);
+    w.u64(d.hash);
+    w.str(d.desc);
+  }
 }
 
 bool Deserialize(const char* data, size_t len, ResponseList* out) {
@@ -150,6 +177,19 @@ bool Deserialize(const char* data, size_t len, ResponseList* out) {
     out->responses.push_back(std::move(resp));
   }
   out->shutdown = r.u8() != 0;
+  int32_t nd = r.i32();
+  if (r.fail || nd < 0 || static_cast<size_t>(nd) > kMaxVector) return false;
+  out->divergence.clear();
+  out->divergence.reserve(nd);
+  for (int32_t i = 0; i < nd; ++i) {
+    DivergenceEntry d;
+    d.rank = r.i32();
+    d.seq = r.i64();
+    d.hash = r.u64();
+    d.desc = r.str();
+    if (r.fail) return false;
+    out->divergence.push_back(std::move(d));
+  }
   return !r.fail;
 }
 

@@ -52,8 +52,20 @@ struct Request {
   TensorShape shape;
 };
 
+// One schedule-verifier checkpoint (analysis/schedule.py): after this
+// rank's ``seq``-th collective submission its rolling hash over every
+// (op, name, dtype, shape) so far was ``hash``; ``desc`` names that
+// submission for the divergence report.  Only populated under
+// HVD_TPU_VERIFY_SCHEDULE.
+struct VerifyEntry {
+  int64_t seq = 0;
+  uint64_t hash = 0;
+  std::string desc;
+};
+
 struct RequestList {
   std::vector<Request> requests;
+  std::vector<VerifyEntry> verify;
   bool shutdown = false;
 };
 
@@ -76,8 +88,20 @@ struct Response {
   std::vector<int64_t> first_dim_sizes;
 };
 
+// One rank's side of a schedule divergence: its ``seq``-th collective
+// submission (the first where rolling hashes disagree across ranks).
+// Broadcast to every rank so hvd.divergence_report() works everywhere,
+// like the coordinated ERROR responses it accompanies.
+struct DivergenceEntry {
+  int32_t rank = 0;
+  int64_t seq = 0;
+  uint64_t hash = 0;
+  std::string desc;
+};
+
 struct ResponseList {
   std::vector<Response> responses;
+  std::vector<DivergenceEntry> divergence;
   bool shutdown = false;
 };
 

@@ -51,6 +51,25 @@ jaxcompat.install()  # jax.shard_map on older pinned jax releases
 Average = True  # default matches reference allreduce(average=True)
 
 
+def _record_schedule(op: str, name: str | None, tensor) -> None:
+    """Feed the runtime schedule verifier (HVD_TPU_VERIFY_SCHEDULE,
+    analysis/schedule.py) at trace/call time: trace order IS program
+    order, so a rank whose Python program issues different collectives is
+    caught even though the collective itself compiles to an XLA op the
+    native engine never sees.  No-op (one env check) when verification is
+    off."""
+    from horovod_tpu.analysis import schedule
+
+    if not schedule.verify_enabled():
+        return
+    try:
+        dtype = jnp.result_type(tensor)
+        shape = jnp.shape(tensor)
+    except Exception:  # non-array payloads (pytrees handled by callers)
+        dtype, shape = "?", ()
+    schedule.record(f"compiled-{op}", name or "<unnamed>", dtype, shape)
+
+
 def _private_axis_env_names() -> tuple[str, ...]:
     """The one touch of private JAX API, isolated so tests can simulate its
     drift (symbol renamed/removed) without disturbing jax internals."""
@@ -162,6 +181,7 @@ def allreduce(tensor, average: bool = True, name: str | None = None,
     the quantized in-mesh collective (shared scale, no error feedback at
     this granularity — use DistributedOptimizer for that).
     """
+    _record_schedule("allreduce", name, tensor)
     if compression is Compression.int8:
         if prescale_factor != 1.0:
             tensor = tensor * prescale_factor
@@ -435,6 +455,8 @@ def grouped_allreduce(tensors: Sequence, average: bool = True,
     (docs/tensor-fusion.md).  Hierarchical (multi-axis) meshes, the eager
     path, and the int8 path in any context: flat ``threshold_bytes``-
     bounded buckets (ops/fusion.py)."""
+    _record_schedule(f"grouped_allreduce[{len(tensors)}]", None,
+                     tensors[0] if len(tensors) else ())
     if compression is Compression.int8:
         # Stateless quantized path (no error feedback): residuals dropped.
         reduced, _ = quantized_grouped_allreduce(
@@ -596,6 +618,7 @@ def allgather(tensor, name: str | None = None):
     carries per-rank dim-0 sizes, operations.cc:576-612, 1273-1332) by
     gathering sizes first, padding to the max, then slicing.
     """
+    _record_schedule("allgather", name, tensor)
     axes = _in_mesh_axes()
     if axes is not None:
         flat_axis = axes if len(axes) > 1 else axes[0]
@@ -628,6 +651,7 @@ def alltoall(tensor, splits=None, name: str | None = None):
     (static shapes).  Eager: negotiated through the native engine with
     optional per-rank ``splits`` (ragged), ops/async_ops.py:alltoall.
     """
+    _record_schedule("alltoall", name, tensor)
     axes = _in_mesh_axes()
     if axes is not None:
         if splits is not None:
@@ -655,6 +679,7 @@ def broadcast(tensor, root_rank: int = 0, name: str | None = None):
     registered broadcast gradient (psum of the cotangent, zeroed off-root;
     tensorflow/mpi_ops.py:146-161) with no custom rule.
     """
+    _record_schedule("broadcast", name, tensor)
     axes = _in_mesh_axes()
     if axes is not None:
         # axis_index over a tuple gives the linearized index across the

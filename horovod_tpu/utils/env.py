@@ -97,6 +97,27 @@ def hierarchical_allreduce() -> bool:
     return bool(raw) and raw not in ("0", "false", "False")
 
 
+def verify_schedule() -> bool:
+    """``HVD_TPU_VERIFY_SCHEDULE`` — debug-mode cross-rank schedule
+    verification (analysis/schedule.py): every submitted collective extends
+    a rolling hash the coordinator compares across ranks, turning a
+    divergent collective order into an immediate coordinated abort with a
+    structured report instead of a stall-timeout hang."""
+    raw = _get("VERIFY_SCHEDULE")
+    return bool(raw) and raw not in ("0", "false", "False")
+
+
+DEFAULT_VERIFY_INTERVAL_TICKS = 10
+
+
+def verify_interval_ticks() -> int:
+    """Coordinator ticks between cross-rank schedule checks
+    (``HVD_TPU_VERIFY_INTERVAL_TICKS``; default 10 — ~50 ms at the default
+    5 ms cycle, cheap enough to leave on for whole debug runs)."""
+    raw = _get("VERIFY_INTERVAL_TICKS")
+    return int(raw) if raw else DEFAULT_VERIFY_INTERVAL_TICKS
+
+
 DEFAULT_OVERLAP_BUCKETS = 4
 
 

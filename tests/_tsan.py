@@ -1,0 +1,25 @@
+"""ThreadSanitizer runtime discovery shared by the tsan-marked tests.
+
+The engine's tsan build must be loaded with the matching libtsan runtime
+LD_PRELOADed (dlopen'ing a tsan .so without it fails with a static-TLS
+error), but the runtime's soname varies by gcc major (libtsan.so.0 on
+gcc-10, .so.2 on gcc-12+) and distros split it across /lib and /usr/lib.
+Probe the usual homes instead of hardcoding one.
+"""
+
+import glob
+
+
+def tsan_runtime() -> str | None:
+    """Absolute path of the libtsan runtime to LD_PRELOAD, or None."""
+    patterns = (
+        "/usr/lib/x86_64-linux-gnu/libtsan.so.*",
+        "/lib/x86_64-linux-gnu/libtsan.so.*",
+        "/usr/lib/gcc/x86_64-linux-gnu/*/libtsan.so",
+        "/usr/lib64/libtsan.so.*",
+    )
+    for pat in patterns:
+        hits = sorted(p for p in glob.glob(pat) if not p.endswith(".py"))
+        if hits:
+            return hits[-1]  # highest version wins
+    return None

@@ -63,7 +63,9 @@ def test_barrier_does_not_leak_store(hvd):
 
     eng = engine_mod.get_engine()
     for _ in range(5):
-        hvd.barrier()
+        # Bare barrier() on purpose: the auto-name path is what must not
+        # leak store entries.
+        hvd.barrier()  # hvd-lint: disable=HVD102
     assert not eng._store, f"leaked store entries: {list(eng._store)}"
 
 

@@ -305,11 +305,10 @@ def test_alltoall_in_mesh(hvd):
 
 def test_alltoall_in_mesh_rejects_splits(hvd):
     from jax.sharding import PartitionSpec as P
-    import pytest as _pytest
 
     fn = hvd.shard(lambda v: hvd.alltoall(v, splits=[1] * 8),
                    in_specs=P("hvd"), out_specs=P("hvd"))
-    with _pytest.raises(Exception, match="eager path"):
+    with pytest.raises(Exception, match="eager path"):
         fn(jnp.arange(8, dtype=jnp.float32))
 
 

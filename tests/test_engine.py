@@ -13,7 +13,9 @@ import json
 import multiprocessing
 import os
 import socket
+import subprocess
 import sys
+import textwrap
 import time
 
 import numpy as np
@@ -30,6 +32,7 @@ from horovod_tpu.core.engine import WIRE_INT8, WIRE_NATIVE
 from horovod_tpu.core.executors import local_executor
 
 from _timing import scaled
+from _tsan import tsan_runtime
 
 
 @pytest.fixture()
@@ -384,3 +387,80 @@ def test_wire_mismatch_error_propagation():
     results = _run_spawn(_worker_wire_mismatch)
     assert {r[0] for r in results} == {"collective-error"}, results
     assert all("Mismatched wire formats" in r[2] for r in results), results
+
+
+def test_duplicate_name_error_names_op_and_fix(engine):
+    """The duplicate-name abort must tell the user WHAT collided and HOW to
+    fix it: the op type and the name= kwarg (the message hvd-lint rule
+    HVD102 points at) — both the Python fast path and the native path."""
+    h = engine.enqueue("dup.msg", np.ones(4, np.float32), OP_ALLREDUCE)
+    with pytest.raises(CollectiveError) as exc:
+        engine.enqueue("dup.msg", np.ones(4, np.float32), OP_ALLREDUCE)
+    msg = str(exc.value)
+    assert "dup.msg" in msg and "allreduce" in msg
+    assert "name=" in msg and "HVD102" in msg
+    engine.synchronize(h)
+
+
+# ---------------------------------------------------------------------------
+# ThreadSanitizer smoke (run via `make check` -m tsan; see also the heavier
+# multi-process tsan matrix in test_multiprocess.py)
+# ---------------------------------------------------------------------------
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TSAN_SMOKE = textwrap.dedent("""
+    import numpy as np
+    from horovod_tpu.core.engine import NativeEngine, OP_ALLREDUCE, \\
+        OP_ALLGATHER, OP_BARRIER
+    from horovod_tpu.core.executors import local_executor
+    import threading
+
+    eng = NativeEngine(0, 1, executor=local_executor, cycle_time_ms=1.0)
+
+    def pound(tid):
+        for i in range(20):
+            h = eng.enqueue(f"s{tid}.{i}", np.ones(32, np.float32),
+                            OP_ALLREDUCE)
+            eng.synchronize(h)
+
+    ts = [threading.Thread(target=pound, args=(t,)) for t in range(3)]
+    for t in ts: t.start()
+    for t in ts: t.join()
+    eng.synchronize(eng.enqueue("g", np.ones((2, 2), np.float32),
+                                OP_ALLGATHER))
+    eng.synchronize(eng.enqueue("bar", np.zeros(1, np.uint8), OP_BARRIER))
+    eng.shutdown()
+    print("SMOKE OK", flush=True)
+""")
+
+
+@pytest.mark.tsan
+@pytest.mark.slow
+def test_engine_tsan_smoke():
+    """Single-process sanity lap of the engine under the ThreadSanitizer
+    build: concurrent clients + executor + background thread, no data-race
+    report implicating libhvdcore.  The fast leg of `make check`'s
+    sanitizer gate (docs/static_analysis.md)."""
+    core = os.path.join(REPO, "horovod_tpu", "core")
+    rc = subprocess.run(["make", "-C", core, "tsan", "-j4"],
+                        capture_output=True)
+    if rc.returncode != 0 and not os.path.exists(
+            os.path.join(core, "libhvdcore_tsan.so")):
+        pytest.skip("tsan build unavailable")
+    runtime = tsan_runtime()
+    if runtime is None:
+        pytest.skip("libtsan runtime not installed")
+    env = {**os.environ, "PYTHONPATH": REPO,
+           "HVD_CORE_LIB": "libhvdcore_tsan.so",
+           "LD_PRELOAD": runtime,
+           "TSAN_OPTIONS": "report_bugs=1 halt_on_error=0 exitcode=0"}
+    proc = subprocess.run([sys.executable, "-c", TSAN_SMOKE],
+                          capture_output=True, text=True, env=env, cwd=REPO,
+                          timeout=scaled(240))
+    assert "SMOKE OK" in proc.stdout, proc.stderr[-3000:]
+    # Only races whose stack touches our library are findings (the
+    # uninstrumented interpreter produces unrelated noise).
+    for chunk in proc.stderr.split("WARNING: ThreadSanitizer")[1:]:
+        assert "hvdcore" not in chunk.split("=" * 18)[0], (
+            f"tsan race in libhvdcore:\n{chunk[:4000]}")

@@ -1,0 +1,307 @@
+"""hvd-lint rule catalog: every rule must fire on its seeded violation
+(exact error code asserted), stay quiet on the clean twin, and honor the
+``# hvd-lint: disable=CODE`` suppression syntax.  The final test dogfoods
+the analyzer on the repo itself — the tree must stay lint-clean
+(docs/static_analysis.md; `make -C horovod_tpu/core check` runs the same
+gate)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+from horovod_tpu.analysis.lint import lint_paths, lint_source
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def codes(src: str) -> list[str]:
+    return [e.code for e in lint_source(textwrap.dedent(src), "fixture.py")]
+
+
+# ---------------------------------------------------------------------------
+# HVD101 — rank-divergent collective
+# ---------------------------------------------------------------------------
+
+def test_hvd101_collective_under_rank_branch():
+    assert codes("""
+        import horovod_tpu as hvd
+
+        def step(x):
+            if hvd.rank() == 0:
+                hvd.allreduce(x)
+    """) == ["HVD101"]
+
+
+def test_hvd101_unbalanced_else_branch():
+    assert codes("""
+        import horovod_tpu as hvd
+
+        def step(x):
+            if hvd.rank() == 0:
+                hvd.allreduce(x)
+            else:
+                hvd.allgather(x)
+    """) == ["HVD101"]
+
+
+def test_hvd101_ifexp_and_local_rank():
+    assert codes("""
+        import horovod_tpu as hvd
+
+        def step(x):
+            y = hvd.broadcast(x, 0) if hvd.local_rank() == 0 else None
+            return y
+    """) == ["HVD101"]
+
+
+def test_hvd101_clean_when_branches_match():
+    assert codes("""
+        import horovod_tpu as hvd
+
+        def step(x, obj):
+            if hvd.rank() == 0:
+                out = hvd.broadcast_object(obj)
+            else:
+                out = hvd.broadcast_object(None)
+            if hvd.rank() == 0:
+                print("root only, no collectives")
+            return out
+    """) == []
+
+
+def test_hvd101_clean_tensor_rank_not_flagged():
+    # tf.rank(x) takes an argument — it's a tensor property, not process
+    # identity; must not trip the rule.
+    assert codes("""
+        import tensorflow as tf
+        import horovod_tpu as hvd
+
+        def step(x):
+            if tf.rank(x) == 2:
+                hvd.allreduce(x)
+    """) == []
+
+
+# ---------------------------------------------------------------------------
+# HVD102 — unnamed engine collective in a loop
+# ---------------------------------------------------------------------------
+
+def test_hvd102_async_in_loop_without_name():
+    assert codes("""
+        import horovod_tpu as hvd
+
+        def push(grads):
+            hs = []
+            while grads:
+                hs.append(hvd.allreduce_async(grads.pop()))
+            return hs
+    """) == ["HVD102"]
+
+
+def test_hvd102_clean_with_name_or_outside_loop():
+    assert codes("""
+        import horovod_tpu as hvd
+
+        def push(grads, x):
+            hvd.allreduce_async(x)  # not in a loop: auto-name is fine
+            return [hvd.allreduce_async(g, name=f"g.{i}")
+                    for i, g in enumerate(grads)]
+    """) == []
+
+
+# ---------------------------------------------------------------------------
+# HVD103 — nondeterministic collective names
+# ---------------------------------------------------------------------------
+
+def test_hvd103_name_from_set_iteration():
+    assert codes("""
+        import horovod_tpu as hvd
+
+        def push(x):
+            for k in {"a", "b"}:
+                hvd.allreduce_async(x, name=f"t.{k}")
+    """) == ["HVD103"]
+
+
+def test_hvd103_name_from_dict_items():
+    assert codes("""
+        import horovod_tpu as hvd
+
+        def push(params):
+            for k, v in params.items():
+                hvd.allreduce_async(v, name=k)
+    """) == ["HVD103"]
+
+
+def test_hvd103_name_from_id():
+    assert codes("""
+        import horovod_tpu as hvd
+
+        def push(t):
+            hvd.broadcast_async(t, 0, name=str(id(t)))
+    """) == ["HVD103"]
+
+
+def test_hvd103_clean_sorted_iteration():
+    assert codes("""
+        import horovod_tpu as hvd
+
+        def push(params, x):
+            for k in sorted(params.items()):
+                hvd.allreduce_async(x, name=f"t.{k}")
+    """) == []
+
+
+# ---------------------------------------------------------------------------
+# HVD104 — impure jitted step functions
+# ---------------------------------------------------------------------------
+
+def test_hvd104_random_time_nprandom_in_jit():
+    assert codes("""
+        import jax
+        import numpy as np
+        import random
+        import time
+
+        @jax.jit
+        def step(x):
+            return x * random.random() + time.time() + np.random.uniform()
+    """) == ["HVD104", "HVD104", "HVD104"]
+
+
+def test_hvd104_partial_jit_and_shard_decorators():
+    assert codes("""
+        import jax
+        import time
+        from functools import partial
+        import horovod_tpu as hvd
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def step(x):
+            return x + time.monotonic()
+
+        @hvd.shard
+        def step2(x):
+            return x + time.time()
+    """) == ["HVD104", "HVD104"]
+
+
+def test_hvd104_clean_jax_random_and_undecorated():
+    assert codes("""
+        import jax
+        from jax import random
+        import time
+
+        @jax.jit
+        def step(x, key):
+            return x + random.normal(key, x.shape)
+
+        def host_loop(x):
+            t0 = time.time()  # not traced: fine
+            return x, t0
+    """) == []
+
+
+# ---------------------------------------------------------------------------
+# HVD105 — unknown mesh axis names
+# ---------------------------------------------------------------------------
+
+def test_hvd105_typoed_axis():
+    assert codes("""
+        from jax import lax
+        from jax.sharding import Mesh
+        import numpy as np
+
+        mesh = Mesh(np.array([0, 1]).reshape(1, 2), ("hvd", "tp"))
+
+        def f(x):
+            return lax.psum(x, "tpp")
+    """) == ["HVD105"]
+
+
+def test_hvd105_clean_declared_and_builtin_axes():
+    assert codes("""
+        from jax import lax
+        import horovod_tpu as hvd
+
+        hvd.init(mesh_axes={"tp": 2})
+
+        def f(x):
+            return lax.psum(lax.psum(x, "tp"), ("dcn", "ici"))
+    """) == []
+
+
+def test_hvd105_inactive_without_mesh_declaration():
+    # No mesh in the module: the rule cannot know the axes — stays quiet.
+    assert codes("""
+        from jax import lax
+
+        def f(x):
+            return lax.psum(x, "model")
+    """) == []
+
+
+# ---------------------------------------------------------------------------
+# Suppression + driver behaviour
+# ---------------------------------------------------------------------------
+
+def test_suppression_comment_and_all():
+    src = """
+        import horovod_tpu as hvd
+
+        def step(x):
+            if hvd.rank() == 0:
+                hvd.allreduce(x)  # hvd-lint: disable=HVD101
+            if hvd.rank() == 1:
+                hvd.allgather(x)  # hvd-lint: disable=all
+    """
+    assert codes(src) == []
+
+
+def test_suppression_wrong_code_does_not_silence():
+    src = """
+        import horovod_tpu as hvd
+
+        def step(x):
+            if hvd.rank() == 0:
+                hvd.allreduce(x)  # hvd-lint: disable=HVD102
+    """
+    assert codes(src) == ["HVD101"]
+
+
+def test_syntax_error_reported_not_crash():
+    assert codes("def broken(:\n    pass") == ["HVD000"]
+
+
+def test_cli_exit_codes(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import horovod_tpu as hvd
+
+        def f(x):
+            if hvd.rank() == 0:
+                hvd.barrier()
+    """))
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    env = {**os.environ, "PYTHONPATH": REPO}
+    rc_bad = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.analysis.lint", str(bad)],
+        capture_output=True, text=True, env=env)
+    assert rc_bad.returncode == 1
+    assert "HVD101" in rc_bad.stdout
+    assert "hint:" in rc_bad.stdout
+    rc_good = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.analysis.lint", str(good)],
+        capture_output=True, text=True, env=env)
+    assert rc_good.returncode == 0, rc_good.stderr
+
+
+def test_repo_is_lint_clean():
+    """Dogfood: the analyzer must pass over our own tree (the acceptance
+    gate `python -m horovod_tpu.analysis.lint examples/ horovod_tpu/
+    tests/` and the lint leg of make check)."""
+    errors = lint_paths([os.path.join(REPO, d)
+                         for d in ("horovod_tpu", "examples", "tests")])
+    assert errors == [], "\n".join(e.render() for e in errors)

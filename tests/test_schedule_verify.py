@@ -1,0 +1,250 @@
+"""Runtime schedule verifier (HVD_TPU_VERIFY_SCHEDULE; analysis/schedule.py
++ core/src/controller.cc).  Contract under test:
+
+* a deliberately rank-divergent job (rank 1 skips one allreduce) aborts
+  with a coordinated CollectiveError carrying the divergence report that
+  names the first mismatched collective per rank — within seconds, NOT
+  after the 60 s stall-warning window;
+* ``divergence_report()`` returns the structured view on every rank (the
+  ``stall_report()`` analog);
+* an unmodified job runs clean under the verifier (no false positives,
+  empty report);
+* with the flag off nothing is recorded (zero overhead on the hot path).
+"""
+
+import multiprocessing
+import os
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from _timing import scaled
+
+from horovod_tpu.analysis.schedule import ScheduleRecorder
+
+
+# ---------------------------------------------------------------------------
+# Recorder unit behaviour (no engine needed)
+# ---------------------------------------------------------------------------
+
+def test_rolling_hash_deterministic_and_order_sensitive():
+    a, b, c = ScheduleRecorder(), ScheduleRecorder(), ScheduleRecorder()
+    ops = [("allreduce", "g0", "float32", (4,)),
+           ("allgather", "g1", "int32", (2, 3)),
+           ("broadcast", "w", "float32", (8,))]
+    for op in ops:
+        a.record(*op)
+        b.record(*op)
+    for op in reversed(ops):
+        c.record(*op)
+    ha = [h for _, h, _ in a.drain()]
+    hb = [h for _, h, _ in b.drain()]
+    hc = [h for _, h, _ in c.drain()]
+    assert ha == hb                      # same schedule -> same hash chain
+    assert ha[-1] != hc[-1]              # same ops, different order -> differ
+    assert len(set(ha)) == len(ha)       # chain rolls, never repeats
+
+
+def test_recorder_distinguishes_metadata():
+    a, b = ScheduleRecorder(), ScheduleRecorder()
+    a.record("allreduce", "g", "float32", (4,))
+    b.record("allreduce", "g", "float16", (4,))
+    (_, ha, _), = a.drain()
+    (_, hb, _), = b.drain()
+    assert ha != hb
+
+
+def test_record_noop_when_disabled(monkeypatch):
+    monkeypatch.delenv("HVD_TPU_VERIFY_SCHEDULE", raising=False)
+    monkeypatch.delenv("HOROVOD_VERIFY_SCHEDULE", raising=False)
+    from horovod_tpu.analysis import schedule
+
+    before = len(schedule.recorder().drain())
+    schedule.record("allreduce", "x", "float32", (4,))
+    assert len(schedule.recorder().drain()) == before == 0
+
+
+# ---------------------------------------------------------------------------
+# Two-process engine integration
+# ---------------------------------------------------------------------------
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _worker_divergent(rank, size, port, q):
+    os.environ["HVD_TPU_VERIFY_SCHEDULE"] = "1"
+    os.environ["HVD_TPU_VERIFY_INTERVAL_TICKS"] = "2"
+    # The verifier must beat the stall machinery to the punch: keep the
+    # stall window at its (long) default so a pass proves the abort came
+    # from divergence detection, not stall escalation.
+    try:
+        from horovod_tpu.core.engine import (CollectiveError, NativeEngine,
+                                             OP_ALLREDUCE)
+        from horovod_tpu.core.executors import local_executor
+
+        eng = NativeEngine(rank, size, executor=local_executor,
+                           coordinator_host="127.0.0.1",
+                           coordinator_port=port, cycle_time_ms=2.0)
+        t0 = time.monotonic()
+        try:
+            handles = []
+            for i in range(4):
+                if i == 2 and rank == 1:
+                    continue  # rank 1 skips one collective: divergence
+                handles.append(eng.enqueue(f"step.{i}",
+                                           np.ones(4, np.float32),
+                                           OP_ALLREDUCE))
+            for h in handles:
+                eng.synchronize(h, timeout_s=scaled(60))
+            q.put(("no-error", rank, None, time.monotonic() - t0))
+        except CollectiveError as e:
+            q.put(("diverged", rank, str(e), time.monotonic() - t0))
+        finally:
+            # The rank that SKIPPED the collective has all of its own ops
+            # legitimately paired, so it may finish before the divergence
+            # verdict lands — the report still must reach it within the
+            # verify cadence (never the stall window).
+            deadline = time.monotonic() + scaled(30)
+            report = eng.divergence_report()
+            while not report and time.monotonic() < deadline:
+                time.sleep(0.02)
+                report = eng.divergence_report()
+            q.put(("report", rank, report, None))
+            eng._shutdown.set()  # engine already stopped itself
+    except Exception as e:  # noqa: BLE001
+        q.put(("err", rank, repr(e), None))
+
+
+def _worker_clean(rank, size, port, q):
+    os.environ["HVD_TPU_VERIFY_SCHEDULE"] = "1"
+    os.environ["HVD_TPU_VERIFY_INTERVAL_TICKS"] = "2"
+    try:
+        from horovod_tpu.core.engine import NativeEngine, OP_ALLGATHER, \
+            OP_ALLREDUCE
+        from horovod_tpu.core.executors import local_executor
+
+        eng = NativeEngine(rank, size, executor=local_executor,
+                           coordinator_host="127.0.0.1",
+                           coordinator_port=port, cycle_time_ms=2.0)
+        outs = []
+        for i in range(6):
+            h = eng.enqueue(f"t.{i}", np.full(8, rank + 1.0, np.float32),
+                            OP_ALLREDUCE)
+            outs.append(float(eng.synchronize(h, timeout_s=scaled(60))[0]))
+        g = eng.synchronize(eng.enqueue("gather", np.ones((rank + 1, 2),
+                                                          np.float32),
+                                        OP_ALLGATHER), timeout_s=scaled(60))
+        report = eng.divergence_report()
+        eng.shutdown()
+        q.put(("ok", rank, (outs, g.shape, report), None))
+    except Exception as e:  # noqa: BLE001
+        q.put(("err", rank, repr(e), None))
+
+
+def _spawn(fn, nprocs, messages_per_proc=1):
+    ctx = multiprocessing.get_context("spawn")
+    port = _free_port()
+    q = ctx.Queue()
+    procs = [ctx.Process(target=fn, args=(r, nprocs, port, q))
+             for r in range(nprocs)]
+    for p in procs:
+        p.start()
+    ok = False
+    try:
+        results = [q.get(timeout=scaled(90))
+                   for _ in range(nprocs * messages_per_proc)]
+        ok = True
+        return results
+    finally:
+        for p in procs:
+            if ok:
+                p.join(timeout=scaled(30))
+            if p.is_alive():
+                p.kill()
+                p.join(timeout=10)
+
+
+def test_divergent_job_aborts_with_report():
+    results = _spawn(_worker_divergent, 2, messages_per_proc=2)
+    assert not [r for r in results if r[0] == "err"], results
+    errors = {r[1]: r for r in results if r[0] == "diverged"}
+    reports = [r for r in results if r[0] == "report"]
+    # Rank 0 is blocked on the collective rank 1 skipped: it MUST abort
+    # with the divergence error instead of hanging to the stall timeout.
+    # (Rank 1's own ops all pair up, so it may legitimately complete.)
+    assert 0 in errors, results
+    _, _, msg, elapsed = errors[0]
+    assert "schedule divergence" in msg.lower(), msg
+    # The first mismatched collective is named for each rank: rank 0's
+    # seq-2 submission is step.2, rank 1's (having skipped it) step.3.
+    assert "step.2" in msg and "step.3" in msg, msg
+    assert "rank 0" in msg and "rank 1" in msg, msg
+    # No stall-timeout wait: detection rides the 2-tick verify cadence.
+    assert elapsed < scaled(30), f"took {elapsed}s — stall-timeout-like"
+    # The structured report reaches EVERY rank (stall_report analog).
+    assert len(reports) == 2, results
+    for _, rank, report, _ in reports:
+        assert [r for r, _, _ in report] == [0, 1], (rank, report)
+        seqs = {s for _, s, _ in report}
+        assert seqs == {2}, report       # first mismatched sequence number
+        descs = sorted(d for _, _, d in report)
+        assert "step.2" in descs[0] and "step.3" in descs[1], report
+
+
+def test_clean_job_runs_clean_under_verifier():
+    results = _spawn(_worker_clean, 2)
+    assert {r[0] for r in results} == {"ok"}, results
+    for _, rank, (outs, gshape, report), _ in results:
+        # local_executor data plane: identity per process — coordination,
+        # not arithmetic, is under test here.
+        assert outs == [rank + 1.0] * 6, (rank, outs)
+        # Ragged allgather (per-rank dim 0) must NOT trip the verifier:
+        # dim 0 is excluded from the schedule hash like the coordinator's
+        # own trailing-dims-only consistency check.
+        assert tuple(gshape) == (rank + 1, 2), (rank, gshape)
+        assert report == [], report      # verifier stayed quiet
+
+
+def test_engine_skips_verify_plumbing_when_disabled(monkeypatch):
+    monkeypatch.delenv("HVD_TPU_VERIFY_SCHEDULE", raising=False)
+    monkeypatch.delenv("HOROVOD_VERIFY_SCHEDULE", raising=False)
+    from horovod_tpu.analysis import schedule
+    from horovod_tpu.core.engine import NativeEngine, OP_ALLREDUCE
+    from horovod_tpu.core.executors import local_executor
+
+    schedule.recorder().reset()
+    eng = NativeEngine(0, 1, executor=local_executor, cycle_time_ms=1.0)
+    try:
+        assert eng._verify_enabled is False
+        eng.synchronize(eng.enqueue("off.t", np.ones(4, np.float32),
+                                    OP_ALLREDUCE))
+        assert schedule.recorder().drain() == []
+        assert eng.divergence_report() == []
+    finally:
+        eng.shutdown()
+
+
+def test_verify_enabled_single_process_roundtrip(monkeypatch):
+    monkeypatch.setenv("HVD_TPU_VERIFY_SCHEDULE", "1")
+    from horovod_tpu.analysis import schedule
+    from horovod_tpu.core.engine import NativeEngine, OP_ALLREDUCE
+    from horovod_tpu.core.executors import local_executor
+
+    schedule.recorder().reset()
+    eng = NativeEngine(0, 1, executor=local_executor, cycle_time_ms=1.0)
+    try:
+        x = np.arange(6, dtype=np.float32)
+        out = eng.synchronize(eng.enqueue("v.t0", x, OP_ALLREDUCE))
+        np.testing.assert_array_equal(out, x)
+        # Single process trivially agrees with itself: no divergence.
+        assert eng.divergence_report() == []
+    finally:
+        eng.shutdown()
+        schedule.recorder().reset()

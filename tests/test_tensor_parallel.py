@@ -2,7 +2,6 @@
 data axis on a 2-D (hvd, tp) mesh."""
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 

@@ -3,7 +3,6 @@
 async+fused, DistributedOptimizer trains, broadcast_parameters /
 broadcast_optimizer_state restore state, grad of allreduce is allreduce."""
 
-import numpy as np
 import pytest
 import torch
 
