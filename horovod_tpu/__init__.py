@@ -36,11 +36,11 @@ __version__ = "0.1.0"
 _ATTR_HOME = {}
 for _mod, _names in {
     "horovod_tpu.basics": (
-        "NotInitializedError", "chips_per_slice", "cross_rank", "cross_size",
-        "init", "is_initialized", "local_num_chips", "local_rank",
-        "local_size", "member_process_ids", "mpi_threads_supported",
-        "num_chips", "rank", "shutdown", "size", "stall_report",
-        "subset_active",
+        "NotInitializedError", "cache_stats", "chips_per_slice", "cross_rank",
+        "cross_size", "init", "is_initialized", "local_num_chips",
+        "local_rank", "local_size", "member_process_ids",
+        "mpi_threads_supported", "num_chips", "rank", "shutdown", "size",
+        "stall_report", "subset_active",
     ),
     "horovod_tpu.analysis.schedule": ("divergence_report",),
     "horovod_tpu.core.engine": ("CollectiveError",),

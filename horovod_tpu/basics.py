@@ -333,6 +333,23 @@ def stall_report() -> list:
     return _engine.stall_report()
 
 
+def cache_stats() -> dict:
+    """Response-cache counters for this rank's eager control plane
+    (docs/response_cache.md): ``{"hits", "misses", "evictions",
+    "bypassed_ticks", "entries", "capacity"}``.
+
+    ``hits`` counts collectives whose negotiated verdict was served from the
+    coordinated response cache (announced as a bit instead of full request
+    metadata); ``bypassed_ticks`` counts coordination cycles this rank
+    announced entirely via the bit vector.  All zeros when the eager engine
+    was never started or ``HOROVOD_CACHE_CAPACITY=0`` — the compiled
+    ``hvd.shard`` path never negotiates, so it never caches."""
+    _topo()
+    from horovod_tpu.core import engine as _engine
+
+    return _engine.cache_stats()
+
+
 def mpi_threads_supported() -> bool:
     """API-parity shim for reference common/__init__.py:147-154.
 

@@ -95,6 +95,7 @@ def _load_library() -> ctypes.CDLL:
     lib.hvd_create.restype = ctypes.c_void_p
     lib.hvd_create.argtypes = [
         ctypes.c_int, ctypes.c_int, ctypes.c_double, ctypes.c_longlong,
+        ctypes.c_longlong,
         ctypes.c_double, ctypes.c_int, ctypes.c_double, ctypes.c_int,
         ctypes.c_int, ctypes.c_int,
         ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
@@ -120,6 +121,9 @@ def _load_library() -> ctypes.CDLL:
     lib.hvd_stall_report.restype = ctypes.c_int
     lib.hvd_stall_report.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                      ctypes.c_int]
+    lib.hvd_cache_stats.restype = None
+    lib.hvd_cache_stats.argtypes = [ctypes.c_void_p,
+                                    ctypes.POINTER(ctypes.c_longlong)]
     lib.hvd_verify_submit.restype = None
     lib.hvd_verify_submit.argtypes = [ctypes.c_void_p, ctypes.c_longlong,
                                       ctypes.c_ulonglong, ctypes.c_char_p]
@@ -214,7 +218,8 @@ class NativeEngine:
                  executor: Callable[["NativeEngine", ExecBatch], None] | None = None,
                  coordinator_host: str | None = None,
                  coordinator_port: int = 0,
-                 cycle_time_ms: float | None = None):
+                 cycle_time_ms: float | None = None,
+                 cache_capacity: int | None = None):
         self.rank = rank
         self.size = size
         self._lib = lib()
@@ -238,6 +243,8 @@ class NativeEngine:
             rank, size,
             cycle_time_ms if cycle_time_ms is not None else env.cycle_time_ms(),
             env.fusion_threshold_bytes(),
+            cache_capacity if cache_capacity is not None
+            else env.cache_capacity(),
             env.stall_warning_seconds(),
             0 if env.stall_check_disabled() else 1,
             env.stall_abort_seconds(),
@@ -361,6 +368,17 @@ class NativeEngine:
     def poll(self, handle: int) -> bool:
         return bool(self._lib.hvd_poll(self._ptr, handle))
 
+    def cache_stats(self) -> dict[str, int]:
+        """This rank's response-cache counters (docs/response_cache.md):
+        ``hits``/``misses``/``evictions``/``bypassed_ticks`` plus the
+        current ``entries`` and configured ``capacity``.  All zeros when
+        ``HOROVOD_CACHE_CAPACITY=0``."""
+        out = (ctypes.c_longlong * 6)()
+        self._lib.hvd_cache_stats(self._ptr, out)
+        return {"hits": int(out[0]), "misses": int(out[1]),
+                "evictions": int(out[2]), "bypassed_ticks": int(out[3]),
+                "entries": int(out[4]), "capacity": int(out[5])}
+
     def stall_report(self) -> list[tuple[str, list[int]]]:
         """Structured stall view: [(tensor_name, [missing ranks]), ...].
 
@@ -424,9 +442,13 @@ class NativeEngine:
     def shutdown(self):
         if self._shutdown.is_set():
             return
-        self._shutdown.set()
+        # Request the coordinated stop BEFORE flagging the executor loop:
+        # batches the coordinator already broadcast keep draining (every
+        # rank dispatched them; a peer may have completed them already) and
+        # the loop exits on the engine's own stopped signal (-1).
         self._lib.hvd_shutdown(self._ptr)
         self._exec_thread.join(timeout=10)
+        self._shutdown.set()
         if self._exec_thread.is_alive():
             # Executor is stuck inside a collective; destroying the native
             # engine now would be a use-after-free when it resumes.  Leak it
@@ -443,9 +465,17 @@ class NativeEngine:
 
     def _exec_loop(self):
         buf = ctypes.create_string_buffer(1 << 20)
-        while not self._shutdown.is_set():
+        while True:
             n = self._lib.hvd_next_batch(self._ptr, buf, len(buf), 100.0)
             if n == 0:
+                # Timeout.  _shutdown is only consulted here (not as the
+                # loop condition) so an engine stopped mid-drain still hands
+                # out its already-broadcast batches before the -1 below —
+                # FailUnscheduled (engine.cc) deliberately leaves those
+                # alive.  The flag alone still exits the loop for tests
+                # that bypass the coordinated path.
+                if self._shutdown.is_set():
+                    return
                 continue
             if n == -1:
                 return
@@ -516,6 +546,18 @@ def stall_report() -> list[tuple[str, list[int]]]:
     with _engine_lock:
         eng = _engine
     return eng.stall_report() if eng is not None else []
+
+
+def cache_stats() -> dict[str, int]:
+    """Module-level response-cache counters; all zeros when the engine was
+    never started (the compiled SPMD path never negotiates, so it never
+    caches)."""
+    with _engine_lock:
+        eng = _engine
+    if eng is None:
+        return {"hits": 0, "misses": 0, "evictions": 0, "bypassed_ticks": 0,
+                "entries": 0, "capacity": 0}
+    return eng.cache_stats()
 
 
 def shutdown_engine() -> None:

@@ -42,16 +42,18 @@ struct Writer {
 extern "C" {
 
 void* hvd_create(int rank, int size, double cycle_ms,
-                 long long fusion_threshold, double stall_seconds,
-                 int stall_check, double stall_abort_seconds,
-                 int stall_abort_exit_code, int verify_schedule,
-                 int verify_interval_ticks, const char* timeline_path,
-                 const char* coord_host, int coord_port) {
+                 long long fusion_threshold, long long cache_capacity,
+                 double stall_seconds, int stall_check,
+                 double stall_abort_seconds, int stall_abort_exit_code,
+                 int verify_schedule, int verify_interval_ticks,
+                 const char* timeline_path, const char* coord_host,
+                 int coord_port) {
   EngineOptions opts;
   opts.rank = rank;
   opts.size = size;
   opts.cycle_time_ms = cycle_ms;
   opts.fusion_threshold_bytes = fusion_threshold;
+  opts.cache_capacity = cache_capacity >= 0 ? cache_capacity : 0;
   opts.stall_warning_seconds = stall_seconds;
   opts.stall_check = stall_check != 0;
   opts.stall_abort_seconds = stall_abort_seconds;
@@ -156,6 +158,18 @@ int hvd_stall_report(void* e, char* buf, int buflen) {
   }
   std::memcpy(buf, w.buf.data(), w.buf.size());
   return static_cast<int>(w.buf.size());
+}
+
+// Response-cache counters (docs/response_cache.md): fills out[0..5] with
+// hits, misses, evictions, bypassed ticks, current entries, capacity.
+void hvd_cache_stats(void* e, long long* out) {
+  auto v = static_cast<Engine*>(e)->CacheStats();
+  out[0] = static_cast<long long>(v.stats.hits);
+  out[1] = static_cast<long long>(v.stats.misses);
+  out[2] = static_cast<long long>(v.stats.evictions);
+  out[3] = static_cast<long long>(v.stats.bypassed_ticks);
+  out[4] = static_cast<long long>(v.entries);
+  out[5] = static_cast<long long>(v.capacity);
 }
 
 // Schedule-verifier intake (analysis/schedule.py): one call per collective

@@ -365,6 +365,154 @@ bool TcpControlPlane::Broadcast(const ResponseList& out) {
 }
 
 // ---------------------------------------------------------------------------
+// ResponseCache (docs/response_cache.md)
+// ---------------------------------------------------------------------------
+
+void ResponseCache::SetCapacity(size_t capacity) {
+  capacity_ = capacity;
+  slots_.assign(capacity, Entry{});
+  by_name_.clear();
+  lru_.clear();
+  free_.clear();
+  free_.reserve(capacity);
+  // Lowest position on top so fresh entries fill bits 0, 1, 2, ... — keeps
+  // the wire bit vector as short as the working set.
+  for (size_t i = capacity; i > 0; --i) {
+    free_.push_back(static_cast<int32_t>(i - 1));
+  }
+}
+
+uint64_t ResponseCache::Signature(const Request& req) {
+  // FNV-1a, the PR-2 schedule-verifier hash (analysis/schedule.py).
+  uint64_t h = 0xCBF29CE484222325ull;
+  auto mix = [&h](const void* p, size_t n) {
+    const unsigned char* b = static_cast<const unsigned char*>(p);
+    for (size_t i = 0; i < n; ++i) {
+      h = (h ^ b[i]) * 0x100000001B3ull;
+    }
+  };
+  int8_t op = static_cast<int8_t>(req.op);
+  int8_t dtype = static_cast<int8_t>(req.dtype);
+  int8_t wire = static_cast<int8_t>(req.wire);
+  mix(&op, 1);
+  mix(&dtype, 1);
+  mix(&wire, 1);
+  mix(&req.root_rank, sizeof(req.root_rank));
+  mix(req.name.data(), req.name.size());
+  for (int64_t d : req.shape.dims) mix(&d, sizeof(d));
+  return h;
+}
+
+ResponseCache::Lookup ResponseCache::Find(const Request& req,
+                                          int32_t* bit) const {
+  auto it = by_name_.find(req.name);
+  if (it == by_name_.end()) return Lookup::MISS;
+  const Entry& e = slots_[static_cast<size_t>(it->second)];
+  if (e.signature != Signature(req)) return Lookup::STALE;
+  *bit = it->second;
+  return Lookup::HIT;
+}
+
+void ResponseCache::EvictSlot(int32_t bit) {
+  Entry& e = slots_[static_cast<size_t>(bit)];
+  if (!e.used) return;
+  by_name_.erase(e.name);
+  lru_.erase(e.lru_it);
+  e = Entry{};
+  stats.evictions++;
+}
+
+void ResponseCache::Store(int32_t bit, const std::string& name,
+                          const Response& resp, uint64_t signature) {
+  if (bit < 0 || static_cast<size_t>(bit) >= capacity_) return;
+  Entry& e = slots_[static_cast<size_t>(bit)];
+  if (e.used && e.name != name) {
+    EvictSlot(bit);  // broadcast-driven eviction: same victim on every rank
+  }
+  if (!e.used) {
+    // Claim the slot (it may come off the free list or from an eviction).
+    auto fit = std::find(free_.begin(), free_.end(), bit);
+    if (fit != free_.end()) free_.erase(fit);
+    by_name_[name] = bit;
+    lru_.push_front(bit);
+    e.used = true;
+    e.name = name;
+    e.lru_it = lru_.begin();
+  } else {
+    lru_.splice(lru_.begin(), lru_, e.lru_it);
+  }
+  e.signature = signature;
+  e.response = resp;
+}
+
+void ResponseCache::Erase(const std::string& name) {
+  auto it = by_name_.find(name);
+  if (it == by_name_.end()) return;
+  int32_t bit = it->second;
+  Entry& e = slots_[static_cast<size_t>(bit)];
+  lru_.erase(e.lru_it);
+  by_name_.erase(it);
+  e = Entry{};
+  free_.push_back(bit);
+}
+
+void ResponseCache::Clear() {
+  size_t cap = capacity_;
+  Stats keep = stats;
+  SetCapacity(cap);
+  stats = keep;
+}
+
+bool ResponseCache::Has(int32_t bit) const {
+  return bit >= 0 && static_cast<size_t>(bit) < capacity_ &&
+         slots_[static_cast<size_t>(bit)].used;
+}
+
+const Response& ResponseCache::At(int32_t bit) const {
+  return slots_[static_cast<size_t>(bit)].response;
+}
+
+const std::string& ResponseCache::NameAt(int32_t bit) const {
+  return slots_[static_cast<size_t>(bit)].name;
+}
+
+int32_t ResponseCache::BitOf(const std::string& name) const {
+  auto it = by_name_.find(name);
+  return it == by_name_.end() ? -1 : it->second;
+}
+
+void ResponseCache::Touch(int32_t bit) {
+  Entry& e = slots_[static_cast<size_t>(bit)];
+  if (e.used) lru_.splice(lru_.begin(), lru_, e.lru_it);
+}
+
+int32_t ResponseCache::AssignSlot(const std::string& name,
+                                  const std::set<int32_t>& pinned) {
+  auto it = by_name_.find(name);
+  if (it != by_name_.end()) return it->second;  // overwrite in place
+  if (!free_.empty()) {
+    int32_t bit = free_.back();
+    // Don't pop: Store() claims it (this keeps AssignSlot/Store idempotent
+    // between the coordinator's decision and its own dispatch replay).
+    // Reserve it by a provisional Store with an empty response so the next
+    // AssignSlot in the same tick picks a different slot.
+    Store(bit, name, Response{}, 0);
+    return bit;
+  }
+  // LRU victim, oldest first, skipping pinned bits (in-flight bit
+  // announcements from earlier ticks must stay resolvable).
+  for (auto rit = lru_.rbegin(); rit != lru_.rend(); ++rit) {
+    if (pinned.count(*rit) != 0) continue;
+    int32_t bit = *rit;
+    EvictSlot(bit);
+    free_.push_back(bit);
+    Store(bit, name, Response{}, 0);
+    return bit;
+  }
+  return -1;  // everything pinned: skip caching this response
+}
+
+// ---------------------------------------------------------------------------
 // Coordinator negotiation (reference IncrementTensorCount +
 // ConstructMPIResponse, operations.cc:282-307, 315-517)
 // ---------------------------------------------------------------------------
@@ -519,12 +667,76 @@ std::vector<DivergenceEntry> Coordinator::CheckDivergence() {
 
 ResponseList Coordinator::Tick(const std::vector<RequestList>& gathered) {
   ResponseList out;
+  // 1. Coordinated invalidation FIRST: a rank that saw its local signature
+  // change sent the name here (plus a full Request below).  The entry must
+  // die on every rank in this same tick, and any other rank's in-flight bit
+  // announcement for it converts back to a full re-announcement (the
+  // announcing rank replays it from bit_announced_ on dispatch).
+  if (cache_ != nullptr && cache_->enabled()) {
+    for (const auto& list : gathered) {
+      for (const auto& name : list.cache_invalidate) {
+        int32_t bit = cache_->BitOf(name);
+        if (bit < 0) continue;  // another rank already invalidated it
+        cache_->Erase(name);
+        pending_bits_.erase(bit);
+        out.cache_invalidate.push_back(name);
+      }
+    }
+  }
   for (size_t rank = 0; rank < gathered.size(); ++rank) {
     const auto& list = gathered[rank];
     if (list.shutdown) out.shutdown = true;
-    for (const auto& req : list.requests) Ingest(req);
+    // 2. Bit-vector intersection: count which ranks re-announced each
+    // cached entry.  Bits whose entry died this tick are dropped — the
+    // announcing rank re-queues the full Request when the invalidation
+    // broadcast reaches it.
+    if (cache_ != nullptr && cache_->enabled()) {
+      for (int32_t bit : list.cache_hits) {
+        if (!cache_->Has(bit)) continue;
+        BitRecord& rec = pending_bits_[bit];
+        if (rec.ready.empty()) {
+          rec.ready.assign(static_cast<size_t>(size_), false);
+          rec.first_seen = std::chrono::steady_clock::now();
+        }
+        if (rank < rec.ready.size() && !rec.ready[rank]) {
+          rec.ready[rank] = true;
+          rec.ready_count++;
+        }
+      }
+    }
+    for (const auto& req : list.requests) {
+      if (cache_ != nullptr && cache_->enabled()) {
+        int32_t bit = cache_->BitOf(req.name);
+        if (bit >= 0) {
+          // Full metadata for a name still in cache: the sender either
+          // flagged it stale (already flushed above, so BitOf misses) or
+          // runs with a different/disabled cache capacity.  Either way the
+          // entry cannot be served coherently any more — flush it on every
+          // rank and fall back to full negotiation, instead of deadlocking
+          // this request against the other ranks' bit announcements.
+          cache_->Erase(req.name);
+          pending_bits_.erase(bit);
+          out.cache_invalidate.push_back(req.name);
+        }
+      }
+      Ingest(req);
+    }
     if (!list.verify.empty()) {
       IngestVerify(static_cast<int>(rank), list.verify);
+    }
+  }
+  // 3. Emit fully-intersected cached entries before the negotiated ones —
+  // they are the latency-sensitive steady state, and the response is just
+  // the bit (every rank expands it from its replica, no re-validation).
+  for (auto it = pending_bits_.begin(); it != pending_bits_.end();) {
+    if (it->second.ready_count >= size_) {
+      Response resp;
+      resp.cache_bit = it->first;
+      cache_->Touch(it->first);
+      out.responses.push_back(std::move(resp));
+      it = pending_bits_.erase(it);
+    } else {
+      ++it;
     }
   }
   // Emit ready tensors in first-announcement order; unready tensors remain.
@@ -535,12 +747,23 @@ ResponseList Coordinator::Tick(const std::vector<RequestList>& gathered) {
   // operations.cc:315-517).
   std::vector<std::string> remaining;
   remaining.reserve(fifo_.size());
+  // Bits still partially announced are pinned: the LRU victim scan must not
+  // evict an entry some rank already committed to by bit.
+  std::set<int32_t> pinned;
+  for (const auto& [bit, rec] : pending_bits_) pinned.insert(bit);
   for (const auto& name : fifo_) {
     auto it = table_.find(name);
     if (it == table_.end()) continue;
     TensorRecord& rec = it->second;
     if (rec.ready_count >= size_) {
-      out.responses.push_back(Finalize(name));
+      Response resp = Finalize(name);
+      // 4. Freshly negotiated success → pick the replica slot every rank
+      // stores it into (cache-populate path; errors are never cached).
+      if (cache_ != nullptr && cache_->enabled() &&
+          resp.type != Response::Type::ERROR) {
+        resp.store_bit = cache_->AssignSlot(name, pinned);
+      }
+      out.responses.push_back(std::move(resp));
       table_.erase(it);
     } else {
       remaining.push_back(name);
@@ -552,7 +775,7 @@ ResponseList Coordinator::Tick(const std::vector<RequestList>& gathered) {
 
 std::vector<StallEntry> Coordinator::StalledTensors() const {
   std::vector<StallEntry> out;
-  if (!stall_check_ || table_.empty()) return out;
+  if (!stall_check_ || (table_.empty() && pending_bits_.empty())) return out;
   auto now = std::chrono::steady_clock::now();
   for (const auto& name : fifo_) {
     auto it = table_.find(name);
@@ -569,14 +792,38 @@ std::vector<StallEntry> Coordinator::StalledTensors() const {
     }
     out.push_back(std::move(e));
   }
+  // Cache-hit announcements waiting on missing ranks stall exactly like
+  // full requests; resolve the bit back to its tensor name for the report.
+  for (const auto& [bit, rec] : pending_bits_) {
+    double waited =
+        std::chrono::duration<double>(now - rec.first_seen).count();
+    if (waited < stall_seconds_) continue;
+    StallEntry e;
+    e.name = (cache_ != nullptr && cache_->Has(bit))
+                 ? cache_->NameAt(bit)
+                 : "<cache bit " + std::to_string(bit) + ">";
+    e.waited_seconds = waited;
+    for (int r = 0; r < size_; ++r) {
+      if (static_cast<size_t>(r) >= rec.ready.size() ||
+          !rec.ready[static_cast<size_t>(r)]) {
+        e.missing_ranks.push_back(r);
+      }
+    }
+    out.push_back(std::move(e));
+  }
   return out;
 }
 
 double Coordinator::OldestPendingSeconds() const {
-  if (table_.empty()) return 0;
+  if (table_.empty() && pending_bits_.empty()) return 0;
   auto now = std::chrono::steady_clock::now();
   double oldest = 0;
   for (const auto& [name, rec] : table_) {
+    double waited =
+        std::chrono::duration<double>(now - rec.first_seen).count();
+    if (waited > oldest) oldest = waited;
+  }
+  for (const auto& [bit, rec] : pending_bits_) {
     double waited =
         std::chrono::duration<double>(now - rec.first_seen).count();
     if (waited > oldest) oldest = waited;
@@ -585,7 +832,7 @@ double Coordinator::OldestPendingSeconds() const {
 }
 
 std::string Coordinator::CheckStalled() {
-  if (!stall_check_ || table_.empty()) return "";
+  if (!stall_check_ || (table_.empty() && pending_bits_.empty())) return "";
   auto now = std::chrono::steady_clock::now();
   if (std::chrono::duration<double>(now - last_stall_warn_).count() <
       stall_seconds_) {

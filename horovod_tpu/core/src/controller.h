@@ -13,8 +13,10 @@
 
 #include <chrono>
 #include <deque>
+#include <list>
 #include <map>
 #include <memory>
+#include <set>
 #include <string>
 #include <unordered_map>
 #include <vector>
@@ -85,6 +87,91 @@ class TcpControlPlane : public ControlPlane {
   std::vector<int> worker_fds_;      // coordinator: index = rank-1
 };
 
+// Capacity-bounded LRU cache of negotiated responses — the rebuild of the
+// response cache Horovod grew in 0.16, one minor version past our 0.15.1
+// snapshot (docs/response_cache.md).  Once a collective's signature
+// (op, name, dtype, shape, root, wire — the PR-2 schedule-verifier tuple)
+// has been coordinated once, workers re-announce it as a bit position in
+// RequestList.cache_hits instead of full Request metadata, and the
+// coordinator intersects bit vectors to emit the cached Response without
+// re-validating.
+//
+// Coherence model: every rank (coordinator included) holds a replica, and
+// ALL replica mutations are driven by the broadcast ResponseList applied in
+// list order — store_bit inserts, cache_invalidate erases, cache_clear —
+// so replicas never diverge.  Slot assignment (free slot / LRU victim) is
+// decided by the coordinator alone; worker LRU order is never consulted.
+// The signature is the one per-rank-local field: each rank checks its OWN
+// current request against its OWN previous one, and the coordinator's bit
+// intersection lifts that to the cross-rank guarantee (every rank unchanged
+// → the original negotiated verdict, ragged allgather dim-0 sizes included,
+// is still valid).
+//
+// Thread-safety: none built in — the engine guards every access with its
+// own mutex (client enqueue lookups, cycle drain, dispatch) and the
+// coordinator only touches it from the engine's background thread.
+class ResponseCache {
+ public:
+  struct Stats {
+    uint64_t hits = 0;
+    uint64_t misses = 0;
+    uint64_t evictions = 0;
+    uint64_t bypassed_ticks = 0;  // cycles announced entirely via bits
+  };
+
+  void SetCapacity(size_t capacity);
+  bool enabled() const { return capacity_ > 0; }
+  size_t capacity() const { return capacity_; }
+  size_t size() const { return by_name_.size(); }
+
+  // FNV-1a over (op, name, dtype, shape, root_rank, wire) — the cache key.
+  static uint64_t Signature(const Request& req);
+
+  enum class Lookup : int8_t { MISS = 0, HIT = 1, STALE = 2 };
+  // HIT fills *bit.  STALE: the name is cached but the signature changed —
+  // the caller must request coordinated invalidation and fall back to full
+  // negotiation.
+  Lookup Find(const Request& req, int32_t* bit) const;
+
+  // Replica maintenance (identical on every rank, broadcast-driven).
+  // Store evicts `bit`'s previous occupant if it held a different name.
+  void Store(int32_t bit, const std::string& name, const Response& resp,
+             uint64_t signature);
+  void Erase(const std::string& name);
+  void Clear();
+
+  bool Has(int32_t bit) const;
+  const Response& At(int32_t bit) const;        // requires Has(bit)
+  const std::string& NameAt(int32_t bit) const;  // requires Has(bit)
+  int32_t BitOf(const std::string& name) const;  // -1 when absent
+
+  // Coordinator-only (authoritative) side: LRU bump on each cache-hit
+  // emission, and slot choice for a freshly negotiated entry — the name's
+  // existing bit, else a free slot, else the least-recently-used victim not
+  // in `pinned` (bits with in-flight announcements must survive until their
+  // response is emitted).  Returns -1 when every slot is pinned.
+  void Touch(int32_t bit);
+  int32_t AssignSlot(const std::string& name, const std::set<int32_t>& pinned);
+
+  Stats stats;
+
+ private:
+  struct Entry {
+    bool used = false;
+    std::string name;
+    uint64_t signature = 0;
+    Response response;
+    std::list<int32_t>::iterator lru_it;
+  };
+  void EvictSlot(int32_t bit);  // erase slot `bit`'s occupant, count it
+
+  size_t capacity_ = 0;
+  std::vector<Entry> slots_;
+  std::unordered_map<std::string, int32_t> by_name_;
+  std::vector<int32_t> free_;  // never-used slots, lowest position on top
+  std::list<int32_t> lru_;     // front = most recently used
+};
+
 // Per-tensor negotiation record (reference message table,
 // operations.cc:282-307).
 struct TensorRecord {
@@ -114,6 +201,12 @@ class Coordinator {
   // Rank 0's timeline receives negotiation phases (reference hooks at
   // operations.cc:292-304).  Not owned; may be null.
   void SetTimeline(Timeline* t) { timeline_ = t; }
+
+  // Rank 0 shares the engine's cache object: the coordinator reads it to
+  // resolve bits and makes the authoritative slot/eviction decisions; the
+  // engine's dispatch applies the same broadcast-driven mutations every
+  // other rank does.  Not owned; may be null (cache disabled).
+  void SetResponseCache(ResponseCache* c) { cache_ = c; }
 
   // Feed one cycle's gathered requests; returns the ordered responses whose
   // tensors became globally ready this cycle (FIFO by first announcement,
@@ -149,10 +242,23 @@ class Coordinator {
   void IngestVerify(int rank, const std::vector<VerifyEntry>& entries);
   Response Finalize(const std::string& name);
 
+  // One cached entry's cross-rank readiness (the bit-vector analog of
+  // TensorRecord: which ranks re-announced cache position `bit` so far).
+  struct BitRecord {
+    std::vector<bool> ready;
+    int ready_count = 0;
+    std::chrono::steady_clock::time_point first_seen;
+  };
+
   int size_;
   double stall_seconds_;
   bool stall_check_;
   Timeline* timeline_ = nullptr;
+  ResponseCache* cache_ = nullptr;
+  // Cache bits announced by a strict subset of ranks, awaiting the rest.
+  // Ordered map: ready bits are emitted in ascending position order, a
+  // deterministic choice every rank's dispatch replays identically.
+  std::map<int32_t, BitRecord> pending_bits_;
   std::unordered_map<std::string, TensorRecord> table_;
   std::vector<std::string> fifo_;      // names in first-announcement order
   std::chrono::steady_clock::time_point last_stall_warn_;

@@ -58,16 +58,27 @@ Status Engine::Start(int* bound_port) {
     if (!cp) return Status::Unknown("control plane: " + err);
     control_ = std::move(cp);
   }
+  if (opts_.cache_capacity > 0) {
+    cache_.SetCapacity(static_cast<size_t>(opts_.cache_capacity));
+  }
   if (control_->is_coordinator()) {
     coordinator_ = std::make_unique<Coordinator>(
         opts_.size, opts_.stall_warning_seconds, opts_.stall_check);
     if (timeline_.Initialized()) coordinator_->SetTimeline(&timeline_);
+    if (cache_.enabled()) coordinator_->SetResponseCache(&cache_);
   }
   thread_ = std::thread(&Engine::Loop, this);
   return Status::OK();
 }
 
-void Engine::Shutdown() { shutdown_requested_.store(true); }
+void Engine::Shutdown() {
+  shutdown_requested_.store(true);
+  // Lock/unlock pairs the store with any waiter between its predicate check
+  // and wait entry (classic lost-wakeup window), then kick the cycle loop so
+  // teardown doesn't wait out the remainder of a cycle tail.
+  { std::lock_guard<std::mutex> l(mu_); }
+  cycle_cv_.notify_all();
+}
 
 int64_t Engine::Enqueue(const std::string& name, OpType op, DataType dtype,
                         const TensorShape& shape, int32_t root_rank,
@@ -100,6 +111,16 @@ int64_t Engine::Enqueue(const std::string& name, OpType op, DataType dtype,
   int64_t handle = next_handle_++;
   handles_[handle] = HandleState{};
   inflight_[name] = {handle, req};
+  if (cache_.enabled()) {
+    // Fast path: a signature the whole job has already coordinated skips
+    // straight to the next cycle instead of waiting out the cycle tail —
+    // cached tensors no longer pay up to cycle_time_ms of enqueue latency.
+    int32_t bit;
+    if (cache_.Find(req, &bit) == ResponseCache::Lookup::HIT) {
+      cycle_wake_ = true;
+      cycle_cv_.notify_one();
+    }
+  }
   pending_enqueues_.emplace_back(handle, std::move(req));
   *status = Status::OK();
   return handle;
@@ -111,10 +132,20 @@ void Engine::Loop() {
   while (!stopped_.load()) {
     auto start = clock::now();
     RunCycle();
-    // Sleep out the remainder of the cycle (reference operations.cc:1696-1703).
+    // Wait out the remainder of the cycle (reference operations.cc:1696-1703)
+    // — but on a condvar, not an uninterruptible sleep_for: a cache-hit
+    // enqueue or a shutdown request ends the wait immediately.  Uncached
+    // names keep the paced cycle.
     auto elapsed = clock::now() - start;
-    if (elapsed < cycle) {
-      std::this_thread::sleep_for(cycle - elapsed);
+    if (elapsed < cycle && !stopped_.load()) {
+      std::unique_lock<std::mutex> l(mu_);
+      WaitWithTimeout(
+          cycle_cv_, l,
+          std::chrono::duration<double, std::milli>(cycle - elapsed).count(),
+          [&] {
+            return cycle_wake_ || stopped_.load() ||
+                   shutdown_requested_.load();
+          });
     }
   }
 }
@@ -123,10 +154,36 @@ void Engine::RunCycle() {
   RequestList own;
   {
     std::lock_guard<std::mutex> l(mu_);
+    cycle_wake_ = false;  // this cycle consumes the pending wake-up
     for (auto& [handle, req] : pending_enqueues_) {
+      if (cache_.enabled()) {
+        int32_t bit = -1;
+        switch (cache_.Find(req, &bit)) {
+          case ResponseCache::Lookup::HIT:
+            // Announce the bit instead of the metadata; keep the request
+            // around in case a coordinated invalidation forces a replay.
+            own.cache_hits.push_back(bit);
+            bit_announced_[req.name] = req;
+            cache_.stats.hits++;
+            continue;
+          case ResponseCache::Lookup::STALE:
+            // Same name, new signature: ask the coordinator to flush the
+            // entry on ALL ranks this tick, and fall through to a full
+            // (re-)negotiation that repopulates it.
+            own.cache_invalidate.push_back(req.name);
+            cache_.stats.misses++;
+            break;
+          case ResponseCache::Lookup::MISS:
+            cache_.stats.misses++;
+            break;
+        }
+      }
       own.requests.push_back(req);
     }
     pending_enqueues_.clear();
+    if (cache_.enabled() && own.requests.empty() && !own.cache_hits.empty()) {
+      cache_.stats.bypassed_ticks++;
+    }
     if (opts_.verify_schedule) {
       own.verify = std::move(pending_verify_);
       pending_verify_.clear();
@@ -143,10 +200,22 @@ void Engine::RunCycle() {
       exec_cv_.notify_all();
       return;
     }
-    responses = coordinator_->Tick(gathered);
+    {
+      // Tick reads/mutates the shared response cache (authoritative slot
+      // and eviction decisions), which client enqueues also probe — so the
+      // pure-compute negotiation step runs under mu_.  Gather/Broadcast
+      // (the blocking transport halves) stay outside the lock.
+      std::lock_guard<std::mutex> l(mu_);
+      responses = coordinator_->Tick(gathered);
+    }
     if (opts_.verify_schedule &&
         ++verify_tick_ % std::max(opts_.verify_interval_ticks, 1) == 0) {
       responses.divergence = coordinator_->CheckDivergence();
+      if (!responses.divergence.empty()) {
+        // Verifier divergence: the coordinated flush rides the same tick —
+        // no rank may keep serving hits from a schedule that just diverged.
+        responses.cache_clear = true;
+      }
     }
     std::string stall = coordinator_->CheckStalled();
     if (!stall.empty()) {
@@ -201,7 +270,13 @@ void Engine::RunCycle() {
   if (responses.shutdown) {
     // Coordinated shutdown: fail whatever never became ready with the
     // reference's "shut down in progress" error (operations.cc:1647-1662).
-    FailAllPending(Status::Aborted(
+    // Batches already negotiated and dispatched are NOT aborted — the
+    // shutdown flag rides the broadcast stream behind their responses, so
+    // every rank dispatched the identical batches and every rank lets them
+    // drain (the reference likewise executes whatever made it out of the
+    // message table; killing a batch a finished peer already completed was
+    // a shutdown/straggler race).
+    FailUnscheduled(Status::Aborted(
         "Horovod has been shut down. This was caused by an exit or shutdown "
         "request on one of the ranks; pending collectives were aborted."));
     stopped_.store(true);
@@ -211,11 +286,74 @@ void Engine::RunCycle() {
 
 void Engine::DispatchResponses(const ResponseList& responses) {
   std::lock_guard<std::mutex> l(mu_);
+  // Response-cache maintenance first, in broadcast order, identically on
+  // every rank (docs/response_cache.md): replicas only ever mutate here, so
+  // they cannot diverge.  A flushed entry this rank had announced by bit is
+  // replayed as a full request next cycle (same handle, no client impact).
+  if (responses.cache_clear && cache_.enabled()) {
+    cache_.Clear();
+    for (auto& [name, req] : bit_announced_) {
+      auto it = inflight_.find(name);
+      if (it != inflight_.end()) {
+        pending_enqueues_.emplace_back(it->second.first, req);
+      }
+    }
+    bit_announced_.clear();
+  }
+  for (const auto& name : responses.cache_invalidate) {
+    cache_.Erase(name);
+    auto ba = bit_announced_.find(name);
+    if (ba != bit_announced_.end()) {
+      auto it = inflight_.find(name);
+      if (it != inflight_.end()) {
+        pending_enqueues_.emplace_back(it->second.first, ba->second);
+      }
+      bit_announced_.erase(ba);
+    }
+  }
+  // Expand cache-hit bits into full responses from the local replica and
+  // store freshly negotiated ones into their assigned slots (signature
+  // computed from OUR request — the one per-rank-local cache field).
+  std::vector<Response> expanded;
+  expanded.reserve(responses.responses.size());
+  for (const auto& r : responses.responses) {
+    if (r.cache_bit >= 0) {
+      if (!cache_.Has(r.cache_bit)) continue;  // flushed this very tick
+      Response full = cache_.At(r.cache_bit);
+      full.cache_bit = r.cache_bit;
+      full.store_bit = -1;
+      for (const auto& name : full.tensor_names) bit_announced_.erase(name);
+      expanded.push_back(std::move(full));
+    } else {
+      if (r.store_bit >= 0 && cache_.enabled() &&
+          r.type != Response::Type::ERROR && r.tensor_names.size() == 1) {
+        auto it = inflight_.find(r.tensor_names[0]);
+        if (it != inflight_.end()) {
+          Response tostore = r;
+          tostore.cache_bit = -1;
+          tostore.store_bit = -1;
+          cache_.Store(r.store_bit, r.tensor_names[0], tostore,
+                       ResponseCache::Signature(it->second.second));
+        }
+      }
+      expanded.push_back(r);
+    }
+  }
+  if (timeline_.Initialized()) {
+    // Tag each tensor's cycle by how its verdict was produced: negotiated
+    // through the full coordinator round, or served from the cache.
+    for (const auto& r : expanded) {
+      for (const auto& name : r.tensor_names) {
+        timeline_.Instant(name, r.cache_bit >= 0 ? "CACHE_HIT"
+                                                 : "NEGOTIATED");
+      }
+    }
+  }
   // Fuse adjacent same-type/same-dtype ALLREDUCE responses up to the byte
   // threshold — in-order, no skipping (reference fusion loop,
   // operations.cc:1807-1842).  Other op types execute one per batch.
   size_t i = 0;
-  const auto& rs = responses.responses;
+  const auto& rs = expanded;
   while (i < rs.size()) {
     const Response& r = rs[i];
     // Look up without erasing: the name stays "in flight" (blocking duplicate
@@ -370,10 +508,38 @@ void Engine::HandleDivergence(const std::vector<DivergenceEntry>& entries) {
   {
     std::lock_guard<std::mutex> l(mu_);
     divergence_ = entries;
+    // Coordinated flush (the divergence tick broadcast cache_clear): a
+    // diverged schedule's cached verdicts are meaningless on every rank.
+    if (cache_.enabled()) cache_.Clear();
   }
   FailAllPending(Status::PreconditionError(text));
   stopped_.store(true);
   exec_cv_.notify_all();
+}
+
+void Engine::FailUnscheduled(const Status& status) {
+  std::lock_guard<std::mutex> l(mu_);
+  // Tensors inside a dispatched batch (queued for or held by the executor)
+  // complete normally; everything still waiting on negotiation aborts.
+  std::unordered_set<std::string> scheduled;
+  for (const auto& b : exec_queue_) {
+    for (const auto& n : b.names) scheduled.insert(n);
+  }
+  for (const auto& [id, b] : executing_) {
+    for (const auto& n : b.names) scheduled.insert(n);
+  }
+  // pending_enqueues_ handles are all present in inflight_ too; the
+  // inflight_ sweep below marks them.
+  pending_enqueues_.clear();
+  bit_announced_.clear();
+  for (auto it = inflight_.begin(); it != inflight_.end();) {
+    if (scheduled.count(it->first) != 0) {
+      ++it;
+      continue;
+    }
+    MarkDone(it->second.first, status);
+    it = inflight_.erase(it);
+  }
 }
 
 void Engine::FailAllPending(const Status& status) {
@@ -382,6 +548,7 @@ void Engine::FailAllPending(const Status& status) {
   pending_enqueues_.clear();
   for (auto& [name, hr] : inflight_) MarkDone(hr.first, status);
   inflight_.clear();
+  bit_announced_.clear();
   for (auto& [id, batch] : executing_) {
     for (auto h : batch.handles) MarkDone(h, status);
   }
@@ -401,6 +568,15 @@ void Engine::MarkDone(int64_t handle, const Status& status) {
 std::vector<StallEntry> Engine::StallReport() {
   std::lock_guard<std::mutex> l(mu_);
   return last_stall_;
+}
+
+Engine::CacheStatsView Engine::CacheStats() {
+  std::lock_guard<std::mutex> l(mu_);
+  CacheStatsView v;
+  v.stats = cache_.stats;
+  v.entries = cache_.size();
+  v.capacity = cache_.capacity();
+  return v;
 }
 
 void Engine::SubmitVerify(int64_t seq, uint64_t hash,

@@ -22,6 +22,7 @@
 #include <string>
 #include <thread>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 #include "common.h"
@@ -60,6 +61,12 @@ struct EngineOptions {
   // (HVD_TPU_STALL_ABORT_SECONDS; docs/fault_tolerance.md).
   double stall_abort_seconds = 0;
   int stall_abort_exit_code = 75;  // EX_TEMPFAIL: transient, retry me
+  // Response cache (HOROVOD_CACHE_CAPACITY; docs/response_cache.md): max
+  // cached negotiated responses, 0 disables.  With the cache on, a stable
+  // per-step schedule stops paying negotiation metadata after the first
+  // step, and a cache-hit enqueue wakes the cycle immediately instead of
+  // waiting out the cycle_time_ms tail.  Default mirrors upstream 0.16.
+  int64_t cache_capacity = 1024;
   // Schedule verifier (HVD_TPU_VERIFY_SCHEDULE, analysis/schedule.py):
   // when on, the coordinator cross-checks per-rank rolling schedule
   // hashes every verify_interval_ticks cycles and fails every pending
@@ -107,6 +114,16 @@ class Engine {
   // snapshot of the last cycle's view — hvd.stall_report() in Python.
   std::vector<StallEntry> StallReport();
 
+  // Response-cache counters for this rank (hvd.cache_stats() in Python):
+  // hits/misses/evictions/bypassed ticks plus current entry count and the
+  // configured capacity.  Thread-safe; all zeros when the cache is off.
+  struct CacheStatsView {
+    ResponseCache::Stats stats;
+    uint64_t entries = 0;
+    uint64_t capacity = 0;
+  };
+  CacheStatsView CacheStats();
+
   // Schedule verifier intake: the Python layer reports each collective
   // submission's (seq, rolling hash, description); forwarded to the
   // coordinator with the next cycle's RequestList.  No-op when
@@ -134,6 +151,9 @@ class Engine {
   void RunCycle();
   void DispatchResponses(const ResponseList& responses);
   void HandleDivergence(const std::vector<DivergenceEntry>& entries);
+  // Coordinated-shutdown teardown: abort tensors still negotiating, but let
+  // batches that every rank already dispatched drain through the executor.
+  void FailUnscheduled(const Status& status);
   void FailAllPending(const Status& status);
   void MarkDone(int64_t handle, const Status& status);
 
@@ -145,8 +165,20 @@ class Engine {
   std::mutex mu_;
   std::condition_variable exec_cv_;
   std::condition_variable done_cv_;
+  // Wakes Loop() out of its between-cycle wait: signalled by a cache-hit
+  // enqueue (run the fast path NOW instead of sleeping out the tick) and by
+  // Shutdown() (don't make teardown wait out a cycle tail).
+  std::condition_variable cycle_cv_;
+  bool cycle_wake_ = false;  // guarded by mu_; cleared when a cycle drains
   std::deque<ExecBatch> exec_queue_;
   std::deque<std::pair<int64_t, Request>> pending_enqueues_;
+  // Response-cache replica (guarded by mu_; docs/response_cache.md).  On
+  // rank 0 the coordinator shares this object for its authoritative slot
+  // and eviction decisions.
+  ResponseCache cache_;
+  // Requests this rank announced as cache bits, awaiting their response —
+  // replayed as full requests if a coordinated invalidation lands first.
+  std::unordered_map<std::string, Request> bit_announced_;  // guarded by mu_
   // Locally announced, not yet completed: name -> (handle, request).
   std::unordered_map<std::string, std::pair<int64_t, Request>> inflight_;
   // Batches handed to the executor, awaiting BatchDone.

@@ -1,5 +1,6 @@
 #include "message.h"
 
+#include <algorithm>
 #include <cstring>
 
 namespace hvd {
@@ -95,6 +96,22 @@ void Serialize(const RequestList& in, std::string* out) {
     w.u64(v.hash);
     w.str(v.desc);
   }
+  // Cache hits as a bit vector: byte count, then one bit per cache slot up
+  // to the highest announced position — a warm steady-state cycle costs
+  // ceil(max_bit/8) bytes instead of per-tensor Request metadata.
+  int32_t max_bit = -1;
+  for (auto b : in.cache_hits) max_bit = std::max(max_bit, b);
+  int32_t nbytes = (max_bit + 8) / 8;  // 0 when no hits
+  w.i32(nbytes);
+  if (nbytes > 0) {
+    std::string bits(static_cast<size_t>(nbytes), '\0');
+    for (auto b : in.cache_hits) {
+      if (b >= 0) bits[static_cast<size_t>(b) / 8] |= static_cast<char>(1 << (b % 8));
+    }
+    w.raw(bits.data(), bits.size());
+  }
+  w.i32(static_cast<int32_t>(in.cache_invalidate.size()));
+  for (const auto& s : in.cache_invalidate) w.str(s);
 }
 
 bool Deserialize(const char* data, size_t len, RequestList* out) {
@@ -131,6 +148,25 @@ bool Deserialize(const char* data, size_t len, RequestList* out) {
     if (r.fail) return false;
     out->verify.push_back(std::move(v));
   }
+  int32_t nbytes = r.i32();
+  if (r.fail || nbytes < 0 || static_cast<size_t>(nbytes) > kMaxVector) {
+    return false;
+  }
+  out->cache_hits.clear();
+  for (int32_t byte = 0; byte < nbytes; ++byte) {
+    uint8_t v = r.u8();
+    for (int bit = 0; bit < 8; ++bit) {
+      if (v & (1u << bit)) out->cache_hits.push_back(byte * 8 + bit);
+    }
+  }
+  int32_t ninv = r.i32();
+  if (r.fail || ninv < 0 || static_cast<size_t>(ninv) > kMaxVector) return false;
+  out->cache_invalidate.clear();
+  out->cache_invalidate.reserve(ninv);
+  for (int32_t i = 0; i < ninv; ++i) {
+    out->cache_invalidate.push_back(r.str());
+    if (r.fail) return false;
+  }
   return !r.fail;
 }
 
@@ -138,13 +174,21 @@ void Serialize(const ResponseList& in, std::string* out) {
   Writer w{out};
   w.i32(static_cast<int32_t>(in.responses.size()));
   for (const auto& resp : in.responses) {
+    // Cache-hit responses are just the bit: every rank expands names/type/
+    // sizes from its replica (docs/response_cache.md wire format).
+    w.i32(resp.cache_bit);
+    if (resp.cache_bit >= 0) continue;
     w.u8(static_cast<uint8_t>(resp.type));
     w.str(resp.error_reason);
     w.i32(static_cast<int32_t>(resp.tensor_names.size()));
     for (const auto& s : resp.tensor_names) w.str(s);
     w.i32(static_cast<int32_t>(resp.first_dim_sizes.size()));
     for (auto d : resp.first_dim_sizes) w.i64(d);
+    w.i32(resp.store_bit);
   }
+  w.i32(static_cast<int32_t>(in.cache_invalidate.size()));
+  for (const auto& s : in.cache_invalidate) w.str(s);
+  w.u8(in.cache_clear ? 1 : 0);
   w.u8(in.shutdown ? 1 : 0);
   w.i32(static_cast<int32_t>(in.divergence.size()));
   for (const auto& d : in.divergence) {
@@ -163,6 +207,12 @@ bool Deserialize(const char* data, size_t len, ResponseList* out) {
   out->responses.reserve(n);
   for (int32_t i = 0; i < n; ++i) {
     Response resp;
+    resp.cache_bit = r.i32();
+    if (r.fail) return false;
+    if (resp.cache_bit >= 0) {
+      out->responses.push_back(std::move(resp));
+      continue;
+    }
     resp.type = static_cast<Response::Type>(r.u8());
     resp.error_reason = r.str();
     int32_t nn = r.i32();
@@ -173,9 +223,19 @@ bool Deserialize(const char* data, size_t len, ResponseList* out) {
     if (r.fail || ns < 0 || static_cast<size_t>(ns) > kMaxVector) return false;
     resp.first_dim_sizes.resize(ns);
     for (int32_t k = 0; k < ns; ++k) resp.first_dim_sizes[k] = r.i64();
+    resp.store_bit = r.i32();
     if (r.fail) return false;
     out->responses.push_back(std::move(resp));
   }
+  int32_t ninv = r.i32();
+  if (r.fail || ninv < 0 || static_cast<size_t>(ninv) > kMaxVector) return false;
+  out->cache_invalidate.clear();
+  out->cache_invalidate.reserve(ninv);
+  for (int32_t i = 0; i < ninv; ++i) {
+    out->cache_invalidate.push_back(r.str());
+    if (r.fail) return false;
+  }
+  out->cache_clear = r.u8() != 0;
   out->shutdown = r.u8() != 0;
   int32_t nd = r.i32();
   if (r.fail || nd < 0 || static_cast<size_t>(nd) > kMaxVector) return false;

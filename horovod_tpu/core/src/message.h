@@ -66,6 +66,15 @@ struct VerifyEntry {
 struct RequestList {
   std::vector<Request> requests;
   std::vector<VerifyEntry> verify;
+  // Response-cache fast path (docs/response_cache.md): positions of cached
+  // entries this rank re-announces this cycle INSTEAD of full Request
+  // metadata — serialized as a compact bit vector (the Horovod 0.16
+  // response-cache line our 0.15.1 snapshot predates).
+  std::vector<int32_t> cache_hits;
+  // Names whose local cache entry went stale (signature changed): the full
+  // Request rides in `requests`; the coordinator must flush the entry on
+  // every rank in the same tick.
+  std::vector<std::string> cache_invalidate;
   bool shutdown = false;
 };
 
@@ -86,6 +95,15 @@ struct Response {
   // Per-rank dim-0 sizes for ALLGATHER (reference's MPI_Allgatherv sizing,
   // operations.cc:576-612).
   std::vector<int64_t> first_dim_sizes;
+  // Response-cache protocol (docs/response_cache.md):
+  //  * cache_bit >= 0 — this response IS cache entry `cache_bit`; nothing
+  //    else is serialized and every rank expands it from its local replica
+  //    (negotiation and re-validation skipped entirely).
+  //  * store_bit >= 0 — freshly negotiated response every rank must store
+  //    into replica slot `store_bit` (evicting that slot's old occupant),
+  //    keeping the replicas aligned without broadcasting positions twice.
+  int32_t cache_bit = -1;
+  int32_t store_bit = -1;
 };
 
 // One rank's side of a schedule divergence: its ``seq``-th collective
@@ -102,6 +120,12 @@ struct DivergenceEntry {
 struct ResponseList {
   std::vector<Response> responses;
   std::vector<DivergenceEntry> divergence;
+  // Coordinated response-cache maintenance, applied by every rank BEFORE
+  // processing `responses` so replicas mutate identically in the same tick:
+  // cache_invalidate erases the named entries (stale signature); cache_clear
+  // flushes everything (schedule divergence).
+  std::vector<std::string> cache_invalidate;
+  bool cache_clear = false;
   bool shutdown = false;
 };
 

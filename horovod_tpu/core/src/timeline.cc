@@ -99,6 +99,16 @@ void Timeline::ActivityEnd(const std::string& name) {
   Emit('E', PidFor(name), "");
 }
 
+void Timeline::Instant(const std::string& name, const std::string& label) {
+  std::lock_guard<std::mutex> l(mu_);
+  if (file_ == nullptr) return;
+  std::fprintf(file_,
+               "{\"ph\": \"i\", \"pid\": %lld, \"tid\": 0, \"ts\": %lld, "
+               "\"name\": \"%s\", \"s\": \"p\"},\n",
+               static_cast<long long>(PidFor(name)),
+               static_cast<long long>(NowMicros()), label.c_str());
+}
+
 void Timeline::End(const std::string& name, const std::string& result) {
   std::lock_guard<std::mutex> l(mu_);
   if (file_ == nullptr) return;

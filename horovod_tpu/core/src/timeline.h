@@ -28,6 +28,9 @@ class Timeline {
   void NegotiateEnd(const std::string& name);
   void ActivityStart(const std::string& name, const std::string& activity);
   void ActivityEnd(const std::string& name);
+  // Instant marker on the tensor's row — tags each dispatch cycle
+  // CACHE_HIT vs NEGOTIATED (docs/response_cache.md).
+  void Instant(const std::string& name, const std::string& label);
   void End(const std::string& name, const std::string& result);
 
  private:

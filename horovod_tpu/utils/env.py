@@ -10,7 +10,14 @@ aliases; the TPU-specific defaults differ where the hardware does:
   reference operations.cc:167).  On TPU this bounds the size of the flat
   bucket we concatenate gradients into before a single ``psum``.
 * ``HOROVOD_CYCLE_TIME`` — background coordination tick in ms (default 5.0,
-  reference operations.cc:155).
+  reference operations.cc:155).  With the response cache on, cache-hit
+  enqueues wake the cycle immediately; the tick paces uncached names only.
+* ``HOROVOD_CACHE_CAPACITY`` — eager response-cache entries (default 1024,
+  mirroring the cache upstream grew in 0.16, one minor version past our
+  0.15.1 snapshot; 0 disables).  Once a collective's (op, name, dtype,
+  shape, root) signature has been coordinated once, ranks re-announce it as
+  a bit in a compact bit vector and the coordinator answers from cache —
+  no negotiation metadata, no cycle-tail latency (docs/response_cache.md).
 * ``HOROVOD_TIMELINE`` — path for the Chrome-tracing timeline (reference
   operations.cc:1556-1560).
 * ``HOROVOD_STALL_CHECK_DISABLE`` — disable the 60 s stall warning
@@ -62,6 +69,16 @@ def cycle_time_ms() -> float:
 
 def timeline_path() -> str | None:
     return _get("TIMELINE")
+
+
+DEFAULT_CACHE_CAPACITY = 1024
+
+
+def cache_capacity() -> int:
+    """``HOROVOD_CACHE_CAPACITY`` — response-cache entries (0 disables;
+    default 1024, upstream 0.16's default).  docs/response_cache.md."""
+    raw = _get("CACHE_CAPACITY")
+    return int(raw) if raw not in (None, "") else DEFAULT_CACHE_CAPACITY
 
 
 def stall_check_disabled() -> bool:

@@ -132,6 +132,20 @@ def test_shutdown_aborts_pending():
     eng._shutdown.set()  # bypass full shutdown (executor thread is stuck)
 
 
+def test_shutdown_fast_under_idle():
+    """shutdown() must interrupt the cycle wait, not sleep out the tail:
+    with a 1 s cycle time an idle engine used to take up to a full cycle to
+    tear down (Loop()'s sleep_for was uninterruptible).  The condvar cycle
+    wait is signalled by shutdown, so teardown is near-instant."""
+    eng = NativeEngine(0, 1, executor=local_executor, cycle_time_ms=1000.0)
+    time.sleep(0.15)  # let the loop enter its between-cycle wait
+    t0 = time.monotonic()
+    eng.shutdown()
+    elapsed = time.monotonic() - t0
+    assert elapsed < 0.5, (
+        f"shutdown took {elapsed:.3f}s — waited out the cycle tail?")
+
+
 def test_timeline_written(tmp_path, monkeypatch):
     path = tmp_path / "timeline.json"
     monkeypatch.setenv("HOROVOD_TIMELINE", str(path))

@@ -1,0 +1,446 @@
+"""Response-cache fast path (docs/response_cache.md).
+
+The eager engine's coordinated response cache must (1) serve stable
+schedules without re-negotiation — bit-vector announcements, immediate
+cycle wake-up, per-op latency decoupled from HOROVOD_CYCLE_TIME; (2) stay
+bit-for-bit compatible with the uncached protocol when
+HOROVOD_CACHE_CAPACITY=0; and (3) stay COHERENT: signature changes flush
+the entry on every rank in the same tick and renegotiate cleanly, never
+diverging ranks or hanging them (the Horovod 0.16 response-cache contract
+our 0.15.1 snapshot predates).
+
+Tensors stay tiny and iteration counts low: tier-1 runs under a hard
+wall-clock budget.
+"""
+
+import multiprocessing
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.core.engine import (  # noqa: I001
+    OP_ALLGATHER,
+    OP_ALLREDUCE,
+    OP_BROADCAST,
+    CollectiveError,
+    NativeEngine,
+)
+from horovod_tpu.core.executors import local_executor
+
+from _timing import scaled
+from _tsan import tsan_runtime
+
+
+def _median(xs):
+    xs = sorted(xs)
+    return xs[len(xs) // 2]
+
+
+# ---------------------------------------------------------------------------
+# Single-process: stats, fast path, eviction, invalidation
+# ---------------------------------------------------------------------------
+
+def test_cache_hits_and_bypassed_ticks():
+    eng = NativeEngine(0, 1, executor=local_executor, cycle_time_ms=1.0,
+                       cache_capacity=16)
+    try:
+        for _ in range(4):
+            for n in range(3):
+                out = eng.synchronize(eng.enqueue(
+                    f"c{n}", np.full(4, 2.0, np.float32), OP_ALLREDUCE))
+                np.testing.assert_array_equal(out, np.full(4, 2.0, np.float32))
+        stats = eng.cache_stats()
+    finally:
+        eng.shutdown()
+    # First sight of each name negotiates (3 misses); every repeat is a hit.
+    assert stats["misses"] == 3, stats
+    assert stats["hits"] == 9, stats
+    assert stats["entries"] == 3 and stats["capacity"] == 16, stats
+    # Hit-only cycles skip negotiation metadata entirely.
+    assert stats["bypassed_ticks"] > 0, stats
+
+
+def test_cache_disabled_is_inert():
+    """HOROVOD_CACHE_CAPACITY=0 must reproduce the uncached engine: correct
+    results, zero counters, no cache machinery on the wire."""
+    eng = NativeEngine(0, 1, executor=local_executor, cycle_time_ms=1.0,
+                       cache_capacity=0)
+    try:
+        for _ in range(3):
+            out = eng.synchronize(eng.enqueue(
+                "off", np.ones(4, np.float32), OP_ALLREDUCE))
+            np.testing.assert_array_equal(out, np.ones(4, np.float32))
+        stats = eng.cache_stats()
+    finally:
+        eng.shutdown()
+    assert stats == {"hits": 0, "misses": 0, "evictions": 0,
+                     "bypassed_ticks": 0, "entries": 0, "capacity": 0}, stats
+
+
+def test_cache_hit_latency_beats_cycle_time():
+    """The event-driven wake-up: with a deliberately huge cycle time, a
+    cache-hit enqueue must complete without waiting out the tick, while the
+    uncached engine pays the full cycle per op."""
+    cycle_ms = 200.0
+
+    def per_op_ms(eng, n_ops):
+        samples = []
+        for _ in range(n_ops):
+            t0 = time.perf_counter()
+            eng.synchronize(eng.enqueue("lat", np.ones(64, np.float32),
+                                        OP_ALLREDUCE))
+            samples.append((time.perf_counter() - t0) * 1000.0)
+        return _median(samples)
+
+    warm_eng = NativeEngine(0, 1, executor=local_executor,
+                            cycle_time_ms=cycle_ms, cache_capacity=8)
+    try:
+        warm_eng.synchronize(warm_eng.enqueue(  # populate the entry
+            "lat", np.ones(64, np.float32), OP_ALLREDUCE))
+        warm = per_op_ms(warm_eng, 5)
+        assert warm_eng.cache_stats()["hits"] >= 5
+    finally:
+        warm_eng.shutdown()
+
+    cold_eng = NativeEngine(0, 1, executor=local_executor,
+                            cycle_time_ms=cycle_ms, cache_capacity=0)
+    try:
+        cold = per_op_ms(cold_eng, 5)
+    finally:
+        cold_eng.shutdown()
+
+    # Uncached ops wait out the coordination tick; cached ops wake it.
+    assert warm < cycle_ms / 2, (warm, cold)
+    assert cold > cycle_ms / 2, (warm, cold)
+    assert cold > 2 * warm, (warm, cold)
+
+
+def test_lru_eviction_stays_correct():
+    eng = NativeEngine(0, 1, executor=local_executor, cycle_time_ms=1.0,
+                       cache_capacity=2)
+    try:
+        for r in range(3):
+            for n in range(4):  # working set (4) > capacity (2): thrash
+                out = eng.synchronize(eng.enqueue(
+                    f"ev{n}", np.full(2, float(n), np.float32), OP_ALLREDUCE))
+                np.testing.assert_array_equal(
+                    out, np.full(2, float(n), np.float32))
+        stats = eng.cache_stats()
+    finally:
+        eng.shutdown()
+    assert stats["evictions"] > 0, stats
+    assert stats["entries"] <= 2, stats
+
+
+def test_signature_change_invalidates_and_repopulates():
+    """Same name, new shape: the stale entry is flushed, the collective
+    renegotiates cleanly, and the NEW signature becomes cacheable."""
+    eng = NativeEngine(0, 1, executor=local_executor, cycle_time_ms=1.0,
+                       cache_capacity=8)
+    try:
+        for _ in range(2):
+            eng.synchronize(eng.enqueue("sig", np.ones(2, np.float32),
+                                        OP_ALLREDUCE))
+        s1 = eng.cache_stats()
+        for _ in range(2):
+            out = eng.synchronize(eng.enqueue("sig", np.ones(5, np.float32),
+                                              OP_ALLREDUCE))
+            assert out.shape == (5,)
+        s2 = eng.cache_stats()
+    finally:
+        eng.shutdown()
+    assert s1["hits"] == 1 and s1["misses"] == 1, s1
+    # Shape change: one more miss (the stale announcement), then hits resume
+    # on the new signature.
+    assert s2["misses"] == 2 and s2["hits"] == 2, s2
+    assert s2["entries"] == 1, s2
+
+
+def test_cached_ops_cover_all_types():
+    """Allgather/broadcast verdicts cache too (per-rank signatures cover the
+    ragged dim 0, so the stored per-rank sizes stay valid on a hit)."""
+    eng = NativeEngine(0, 1, executor=local_executor, cycle_time_ms=1.0,
+                       cache_capacity=8)
+    try:
+        x = np.arange(6, dtype=np.int64).reshape(2, 3)
+        for _ in range(3):
+            np.testing.assert_array_equal(
+                eng.synchronize(eng.enqueue("t.ag", x, OP_ALLGATHER)), x)
+            np.testing.assert_array_equal(
+                eng.synchronize(eng.enqueue("t.bc", x, OP_BROADCAST,
+                                            root_rank=0)), x)
+        stats = eng.cache_stats()
+    finally:
+        eng.shutdown()
+    assert stats["misses"] == 2 and stats["hits"] == 4, stats
+
+
+def test_timeline_tags_cache_hit_vs_negotiated(tmp_path, monkeypatch):
+    """Rank 0's timeline marks each dispatch cycle with how its verdict was
+    produced (docs/timeline.md)."""
+    path = tmp_path / "timeline.json"
+    monkeypatch.setenv("HOROVOD_TIMELINE", str(path))
+    eng = NativeEngine(0, 1, executor=local_executor, cycle_time_ms=1.0,
+                       cache_capacity=8)
+    try:
+        for _ in range(3):
+            eng.synchronize(eng.enqueue("tl.c", np.ones(4, np.float32),
+                                        OP_ALLREDUCE))
+    finally:
+        eng.shutdown()
+    text = path.read_text()
+    assert "NEGOTIATED" in text       # the populating first pass
+    assert "CACHE_HIT" in text        # the cached repeats
+    assert "NEGOTIATE_ALLREDUCE" in text  # negotiation span still traced
+
+
+# ---------------------------------------------------------------------------
+# Multi-process coherence (TCP control plane, spawn harness as in
+# test_engine.py)
+# ---------------------------------------------------------------------------
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run_spawn(fn, nprocs=2):
+    ctx = multiprocessing.get_context("spawn")
+    port = _free_port()
+    q = ctx.Queue()
+    procs = [ctx.Process(target=fn, args=(r, nprocs, port, q))
+             for r in range(nprocs)]
+    for p in procs:
+        p.start()
+    ok = False
+    try:
+        results = [q.get(timeout=scaled(60)) for _ in procs]
+        ok = True
+        return results
+    finally:
+        for p in procs:
+            if ok:
+                p.join(timeout=scaled(30))
+            if p.is_alive():
+                p.kill()
+                p.join(timeout=10)
+
+
+def _worker_stream(rank, size, port, q):
+    """(a) Stable schedule with a NEW name appearing mid-stream on all
+    ranks: miss -> negotiate -> subsequent hits; results stay correct."""
+    try:
+        eng = NativeEngine(rank, size, executor=local_executor,
+                           coordinator_host="127.0.0.1",
+                           coordinator_port=port, cycle_time_ms=2.0,
+                           cache_capacity=32)
+        # local_executor is an identity data plane (as in test_engine.py's
+        # TCP tests): each rank sees its own input back.  The cache is a
+        # CONTROL-plane feature — what's under test is that every op still
+        # completes, in order, with coherent replicas.
+        for step in range(4):
+            out = eng.synchronize(eng.enqueue(
+                "s.a", np.full(4, float(rank), np.float32), OP_ALLREDUCE),
+                timeout_s=scaled(30))
+            assert out[0] == float(rank), out
+            if step >= 2:  # new tensor joins the schedule mid-stream
+                out = eng.synchronize(eng.enqueue(
+                    "s.b", np.full(2, float(rank), np.float32), OP_ALLREDUCE),
+                    timeout_s=scaled(30))
+                assert out[0] == float(rank), out
+        stats = eng.cache_stats()
+        eng.shutdown()
+        q.put(("ok", rank, stats))
+    except Exception as e:  # noqa: BLE001
+        q.put(("err", rank, repr(e)))
+
+
+def test_new_name_mid_stream_then_hits():
+    results = _run_spawn(_worker_stream)
+    assert {r[0] for r in results} == {"ok"}, results
+    for _, rank, stats in results:
+        # s.a: 1 miss + 3 hits; s.b: 1 miss + 1 hit — on every rank.
+        assert stats["misses"] == 2, (rank, stats)
+        assert stats["hits"] == 4, (rank, stats)
+
+
+def _worker_coordinated_reshape(rank, size, port, q):
+    """(b) All ranks re-announce a cached name with a new shape together:
+    coordinated invalidate, clean renegotiation, hits resume."""
+    try:
+        eng = NativeEngine(rank, size, executor=local_executor,
+                           coordinator_host="127.0.0.1",
+                           coordinator_port=port, cycle_time_ms=2.0,
+                           cache_capacity=32)
+        for shape in (3, 5):
+            for _ in range(2):
+                out = eng.synchronize(eng.enqueue(
+                    "r.x", np.full(shape, 1.0, np.float32), OP_ALLREDUCE),
+                    timeout_s=scaled(30))
+                # local_executor identity data plane; shape/order are the
+                # control-plane facts under test.
+                assert out.shape == (shape,) and out[0] == 1.0, out
+        stats = eng.cache_stats()
+        eng.shutdown()
+        q.put(("ok", rank, stats))
+    except Exception as e:  # noqa: BLE001
+        q.put(("err", rank, repr(e)))
+
+
+def test_coordinated_shape_change_renegotiates():
+    results = _run_spawn(_worker_coordinated_reshape)
+    assert {r[0] for r in results} == {"ok"}, results
+    for _, rank, stats in results:
+        assert stats["misses"] == 2 and stats["hits"] == 2, (rank, stats)
+
+
+def _worker_lone_reshape(rank, size, port, q):
+    """(b') ONE rank re-announces a cached name with a different shape: the
+    entry is flushed everywhere and the renegotiation surfaces the shape
+    mismatch as a coordinated error on every rank — no divergence abort, no
+    hang, no rank served from a stale cache."""
+    try:
+        eng = NativeEngine(rank, size, executor=local_executor,
+                           coordinator_host="127.0.0.1",
+                           coordinator_port=port, cycle_time_ms=2.0,
+                           cache_capacity=32)
+        for _ in range(2):  # warm the entry on every rank
+            eng.synchronize(eng.enqueue("l.x", np.ones(4, np.float32),
+                                        OP_ALLREDUCE), timeout_s=scaled(30))
+        x = np.ones(4 + (1 if rank == 0 else 0), np.float32)
+        h = eng.enqueue("l.x", x, OP_ALLREDUCE)
+        try:
+            eng.synchronize(h, timeout_s=scaled(30))
+            q.put(("no-error", rank, None))
+        except CollectiveError as e:
+            q.put(("collective-error", rank, str(e)))
+        eng.shutdown()
+    except Exception as e:  # noqa: BLE001
+        q.put(("err", rank, repr(e)))
+
+
+def test_lone_shape_change_is_coordinated_error():
+    results = _run_spawn(_worker_lone_reshape)
+    assert {r[0] for r in results} == {"collective-error"}, results
+    assert all("Mismatched shapes" in r[2] for r in results), results
+
+
+def _worker_mixed_capacity(rank, size, port, q):
+    """Misconfigured jobs (one rank with the cache disabled) must degrade to
+    full negotiation everywhere, not deadlock bit announcements against full
+    requests.  HOROVOD_CACHE_CAPACITY should match across ranks; this pins
+    the failure mode when it doesn't."""
+    try:
+        eng = NativeEngine(rank, size, executor=local_executor,
+                           coordinator_host="127.0.0.1",
+                           coordinator_port=port, cycle_time_ms=2.0,
+                           cache_capacity=32 if rank == 0 else 0)
+        for _ in range(3):
+            out = eng.synchronize(eng.enqueue(
+                "m.x", np.full(4, 1.0, np.float32), OP_ALLREDUCE),
+                timeout_s=scaled(30))
+            assert out[0] == 1.0, out
+        eng.shutdown()
+        q.put(("ok", rank, None))
+    except Exception as e:  # noqa: BLE001
+        q.put(("err", rank, repr(e)))
+
+
+def test_mismatched_capacity_degrades_not_deadlocks():
+    results = _run_spawn(_worker_mixed_capacity)
+    assert {r[0] for r in results} == {"ok"}, results
+
+
+def _worker_verify_interop(rank, size, port, q):
+    """(c) HVD_TPU_VERIFY_SCHEDULE=1 interop: the verifier's rolling hashes
+    still cross-check on the cache-hit path (the checkpoint stream is
+    recorded at enqueue, which the cache does not bypass)."""
+    try:
+        os.environ["HVD_TPU_VERIFY_SCHEDULE"] = "1"
+        os.environ["HVD_TPU_VERIFY_INTERVAL_TICKS"] = "2"
+        eng = NativeEngine(rank, size, executor=local_executor,
+                           coordinator_host="127.0.0.1",
+                           coordinator_port=port, cycle_time_ms=2.0,
+                           cache_capacity=32)
+        for i in range(6):
+            eng.synchronize(eng.enqueue("v.x", np.ones(4, np.float32),
+                                        OP_ALLREDUCE), timeout_s=scaled(30))
+        stats = eng.cache_stats()
+        div = eng.divergence_report()
+        eng.shutdown()
+        q.put(("ok", rank, (stats["hits"], div)))
+    except Exception as e:  # noqa: BLE001
+        q.put(("err", rank, repr(e)))
+
+
+def test_schedule_verifier_cross_checks_cached_path():
+    results = _run_spawn(_worker_verify_interop)
+    assert {r[0] for r in results} == {"ok"}, results
+    for _, rank, (hits, div) in results:
+        assert hits >= 4, (rank, hits)         # the schedule WAS cached
+        assert div == [], (rank, div)          # and verified clean
+
+
+# ---------------------------------------------------------------------------
+# ThreadSanitizer: concurrent cache-hit enqueues + shutdown (the condvar
+# wake-up path; `make -C horovod_tpu/core check` runs this leg)
+# ---------------------------------------------------------------------------
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TSAN_CACHE = textwrap.dedent("""
+    import numpy as np, threading
+    from horovod_tpu.core.engine import NativeEngine, OP_ALLREDUCE
+    from horovod_tpu.core.executors import local_executor
+
+    eng = NativeEngine(0, 1, executor=local_executor, cycle_time_ms=1.0,
+                       cache_capacity=8)
+
+    def pound(tid):
+        # Per-thread names so every iteration past the first is a cache hit
+        # racing the cycle condvar, the drain, and the other threads.
+        for i in range(30):
+            h = eng.enqueue(f"c{tid}", np.ones(16, np.float32), OP_ALLREDUCE)
+            eng.synchronize(h)
+
+    ts = [threading.Thread(target=pound, args=(t,)) for t in range(3)]
+    for t in ts: t.start()
+    for t in ts: t.join()
+    assert eng.cache_stats()["hits"] >= 3 * 29, eng.cache_stats()
+    eng.shutdown()  # exercises the cycle_cv_ shutdown wake-up under tsan
+    print("CACHE TSAN OK", flush=True)
+""")
+
+
+@pytest.mark.tsan
+@pytest.mark.slow
+def test_cache_tsan_concurrent_hits_and_shutdown():
+    core = os.path.join(REPO, "horovod_tpu", "core")
+    rc = subprocess.run(["make", "-C", core, "tsan", "-j4"],
+                        capture_output=True)
+    if rc.returncode != 0 and not os.path.exists(
+            os.path.join(core, "libhvdcore_tsan.so")):
+        pytest.skip("tsan build unavailable")
+    runtime = tsan_runtime()
+    if runtime is None:
+        pytest.skip("libtsan runtime not installed")
+    env = {**os.environ, "PYTHONPATH": REPO,
+           "HVD_CORE_LIB": "libhvdcore_tsan.so",
+           "LD_PRELOAD": runtime,
+           "TSAN_OPTIONS": "report_bugs=1 halt_on_error=0 exitcode=0"}
+    proc = subprocess.run([sys.executable, "-c", TSAN_CACHE],
+                          capture_output=True, text=True, env=env, cwd=REPO,
+                          timeout=scaled(240))
+    assert "CACHE TSAN OK" in proc.stdout, proc.stderr[-3000:]
+    for chunk in proc.stderr.split("WARNING: ThreadSanitizer")[1:]:
+        assert "hvdcore" not in chunk.split("=" * 18)[0], (
+            f"tsan race in libhvdcore:\n{chunk[:4000]}")
