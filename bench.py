@@ -27,13 +27,29 @@ Two phases, one JSON metric line each:
    recorded for trend tracking, not as a same-silicon comparison).
 
 ``BENCH_SKIP_EAGER=1`` / ``BENCH_SKIP_RESNET=1`` run one phase alone.
+
+3. **Fault-detection MTTR** (``bench.py --fault``) — two-process engine
+   job; rank 1 is SIGKILLed at steady state and the survivor's
+   peer-failure abort (heartbeats + hardened frames,
+   docs/fault_tolerance.md) is timed end to end::
+
+       {"metric": "failure_detection_ms", "value": N, "unit": "ms",
+        "vs_baseline": <60 s stall window / value>,
+        "wire_drop_silence_ms": <heartbeat-timeout path>}
+
+   ``vs_baseline`` is the MTTR improvement over the pre-heartbeat story,
+   where a dead peer sat invisible until the 60 s stall detector fired.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import signal
+import socket
+import subprocess
 import sys
+import textwrap
 import time
 
 BASELINE_IMG_PER_SEC_PER_DEVICE = 1656.82 / 16  # reference docs/benchmarks.md:34-38
@@ -82,7 +98,89 @@ def eager_microbench() -> None:
     }))
 
 
+_FAULT_WORKER = textwrap.dedent("""
+    import sys, time
+    import numpy as np
+    from horovod_tpu.core.engine import NativeEngine, OP_ALLREDUCE, \\
+        CollectiveError
+    from horovod_tpu.core.executors import local_executor
+
+    rank, port = int(sys.argv[1]), int(sys.argv[2])
+    eng = NativeEngine(rank, 2, executor=local_executor,
+                       coordinator_host="127.0.0.1", coordinator_port=port,
+                       cycle_time_ms=2.0)
+    i = 0
+    try:
+        while True:
+            h = eng.enqueue(f"b{i}", np.ones(1024, np.float32), OP_ALLREDUCE)
+            eng.synchronize(h, timeout_s=120.0)
+            i += 1
+            if i == 20:
+                print("STEADY", flush=True)
+    except CollectiveError:
+        print(f"REPORT={eng.failure_report()!r}", flush=True)
+        time.sleep(30)  # the abort grace exits 75
+""")
+
+
+def fault_bench() -> None:
+    """MTTR of the failure-detection layer (docs/fault_tolerance.md): wall
+    time from SIGKILLing a rank to the survivor's structured exit-75 abort
+    (EOF path), plus the heartbeat-timeout path's silence-to-detection
+    from a wire-DROP run's failure_report."""
+    here = os.path.dirname(os.path.abspath(__file__))
+
+    def run(extra_env):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        env = {**os.environ, "PYTHONPATH": here,
+               "HVD_TPU_HEARTBEAT_MS": "50",
+               "HVD_TPU_HEARTBEAT_TIMEOUT_MS": "1000",
+               "HVD_TPU_ABORT_GRACE_MS": "100", **extra_env}
+        procs = [subprocess.Popen(
+            [sys.executable, "-c", _FAULT_WORKER, str(r), str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=here) for r in (0, 1)]
+        return procs
+
+    # EOF path: SIGKILL rank 1 at steady state, time the survivor's abort.
+    procs = run({})
+    for line in procs[0].stdout:
+        if "STEADY" in line:
+            break
+    procs[1].send_signal(signal.SIGKILL)
+    t_kill = time.perf_counter()
+    out0, _ = procs[0].communicate(timeout=120)
+    detect_ms = (time.perf_counter() - t_kill) * 1e3
+    procs[1].wait()
+    assert procs[0].returncode == 75, (procs[0].returncode, out0[-1000:])
+
+    # Heartbeat-timeout path: rank 1 silently DROPs all frames; the
+    # survivor's report records how long the silence lasted at detection.
+    procs = run({"HVD_TPU_FAULT_WIRE_DROP": "1:400"})
+    out0, _ = procs[0].communicate(timeout=120)
+    procs[1].communicate(timeout=120)
+    silence_ms = -1.0
+    if "'last_heard_ms': " in out0:
+        silence_ms = float(
+            out0.split("'last_heard_ms': ", 1)[1].split(",", 1)[0])
+
+    stall_window_ms = 60_000.0  # the pre-heartbeat detection floor
+    print(json.dumps({
+        "metric": "failure_detection_ms",
+        "value": round(detect_ms, 1),
+        "unit": "ms",
+        "vs_baseline": round(stall_window_ms / max(detect_ms, 1e-9), 1),
+        "wire_drop_silence_ms": round(silence_ms, 1),
+    }))
+
+
 def main() -> None:
+    if "--fault" in sys.argv:
+        fault_bench()
+        return
     if os.environ.get("BENCH_SKIP_EAGER") != "1":
         eager_microbench()
     if os.environ.get("BENCH_SKIP_RESNET") == "1":

@@ -37,8 +37,8 @@ _ATTR_HOME = {}
 for _mod, _names in {
     "horovod_tpu.basics": (
         "NotInitializedError", "cache_stats", "chips_per_slice", "cross_rank",
-        "cross_size", "init", "is_initialized", "local_num_chips",
-        "local_rank", "local_size", "member_process_ids",
+        "cross_size", "failure_report", "init", "is_initialized",
+        "local_num_chips", "local_rank", "local_size", "member_process_ids",
         "mpi_threads_supported", "num_chips", "rank", "shutdown", "size",
         "stall_report", "subset_active",
     ),

@@ -333,6 +333,32 @@ def stall_report() -> list:
     return _engine.stall_report()
 
 
+def failure_report() -> dict | None:
+    """Structured peer-failure report from the eager control plane — the
+    peer-death analog of :func:`stall_report` / ``hvd.divergence_report()``
+    (docs/fault_tolerance.md "Fast failure detection").
+
+    ``None`` while every peer is healthy (or the eager engine never
+    started); after a peer death is detected — socket EOF from a SIGKILLed
+    or preempted rank, heartbeat silence past
+    ``HVD_TPU_HEARTBEAT_TIMEOUT_MS``, a hardened-frame CRC/desync
+    violation, or a mixed-build version skew — every surviving rank
+    returns::
+
+        {"failed_rank": 1, "cause": "connection_reset",
+         "detail": "rank 1 closed the control-plane connection (EOF)",
+         "last_heard_ms": 4.2, "last_collective": "grad.step3"}
+
+    Pending collectives fail with :class:`hvd.CollectiveError` carrying the
+    same report, and after ``HVD_TPU_ABORT_GRACE_MS`` the process exits
+    with the restartable code (75) so ``python -m horovod_tpu.run
+    --max-restarts N`` relaunches from the last complete checkpoint."""
+    _topo()
+    from horovod_tpu.core import engine as _engine
+
+    return _engine.failure_report()
+
+
 def cache_stats() -> dict:
     """Response-cache counters for this rank's eager control plane
     (docs/response_cache.md): ``{"hits", "misses", "evictions",

@@ -130,6 +130,9 @@ def _load_library() -> ctypes.CDLL:
     lib.hvd_divergence_report.restype = ctypes.c_int
     lib.hvd_divergence_report.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                           ctypes.c_int]
+    lib.hvd_failure_report.restype = ctypes.c_int
+    lib.hvd_failure_report.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       ctypes.c_int]
     lib.hvd_poll.restype = ctypes.c_int
     lib.hvd_poll.argtypes = [ctypes.c_void_p, ctypes.c_longlong]
     lib.hvd_wait.restype = ctypes.c_int
@@ -379,6 +382,63 @@ class NativeEngine:
                 "evictions": int(out[2]), "bypassed_ticks": int(out[3]),
                 "entries": int(out[4]), "capacity": int(out[5])}
 
+    def failure_report(self) -> dict | None:
+        """Structured peer-failure view (docs/fault_tolerance.md): ``None``
+        while every peer is healthy, else a dict naming the failed rank and
+        how its death was observed::
+
+            {"failed_rank": 1, "cause": "connection_reset",
+             "detail": "...", "last_heard_ms": 4.2,
+             "last_collective": "grad.step3"}
+
+        ``cause`` is one of ``connection_reset`` (socket EOF/RST — e.g. a
+        SIGKILLed or preempted rank), ``heartbeat_timeout`` (silent past
+        ``HVD_TPU_HEARTBEAT_TIMEOUT_MS`` — e.g. a network partition),
+        ``frame_corrupt`` / ``frame_desync`` (hardened-wire CRC or framing
+        violation), ``version_skew`` (mixed-build peer), or
+        ``connection_lost`` (send error).  The peer-death analog of
+        :meth:`stall_report` and :meth:`divergence_report`."""
+        buf = ctypes.create_string_buffer(1 << 14)
+        n = self._lib.hvd_failure_report(self._ptr, buf, len(buf))
+        if n < -1:
+            buf = ctypes.create_string_buffer(-n + 16)
+            n = self._lib.hvd_failure_report(self._ptr, buf, len(buf))
+        if n <= 0:
+            return None
+        raw = buf.raw[:n]
+        off = 0
+
+        def i32():
+            nonlocal off
+            v = struct.unpack_from("<i", raw, off)[0]
+            off += 4
+            return v
+
+        def i64():
+            nonlocal off
+            v = struct.unpack_from("<q", raw, off)[0]
+            off += 8
+            return v
+
+        def s():
+            nonlocal off
+            ln = i32()
+            v = raw[off:off + ln].decode()
+            off += ln
+            return v
+
+        if i32() == 0:
+            return None
+        failed_rank = i32()
+        cause = s()
+        detail = s()
+        last_heard_us = i64()
+        last_collective = s()
+        return {"failed_rank": failed_rank, "cause": cause, "detail": detail,
+                "last_heard_ms": (last_heard_us / 1000.0
+                                  if last_heard_us >= 0 else None),
+                "last_collective": last_collective}
+
     def stall_report(self) -> list[tuple[str, list[int]]]:
         """Structured stall view: [(tensor_name, [missing ranks]), ...].
 
@@ -558,6 +618,14 @@ def cache_stats() -> dict[str, int]:
         return {"hits": 0, "misses": 0, "evictions": 0, "bypassed_ticks": 0,
                 "entries": 0, "capacity": 0}
     return eng.cache_stats()
+
+
+def failure_report() -> dict | None:
+    """Module-level peer-failure report; ``None`` when the engine was never
+    started (no control plane, no peers to lose)."""
+    with _engine_lock:
+        eng = _engine
+    return eng.failure_report() if eng is not None else None
 
 
 def shutdown_engine() -> None:

@@ -5,6 +5,7 @@
 // horovod_tpu/core/engine.py with ctypes instead of a pybind11 module (the
 // image has no pybind11; the surface is small and stable enough for a plain
 // C ABI).
+#include <cstdlib>
 #include <cstring>
 #include <string>
 
@@ -37,6 +38,17 @@ struct Writer {
   }
 };
 
+// Heartbeat knobs ride the environment (like HVD_TPU_CONNECT_TIMEOUT in
+// controller.cc) rather than widening the create ABI: they are pure
+// control-plane tuning, documented in utils/env.py.
+double EnvMs(const char* horovod_name, const char* hvd_tpu_name,
+             double fallback) {
+  const char* v = std::getenv(horovod_name);
+  if (v == nullptr || *v == '\0') v = std::getenv(hvd_tpu_name);
+  if (v == nullptr || *v == '\0') return fallback;
+  return std::atof(v);
+}
+
 }  // namespace
 
 extern "C" {
@@ -67,6 +79,13 @@ void* hvd_create(int rank, int size, double cycle_ms,
   if (timeline_path != nullptr) opts.timeline_path = timeline_path;
   if (coord_host != nullptr) opts.coordinator_host = coord_host;
   opts.coordinator_port = coord_port;
+  opts.heartbeat_ms = EnvMs("HOROVOD_HEARTBEAT_MS", "HVD_TPU_HEARTBEAT_MS",
+                            opts.heartbeat_ms);
+  opts.heartbeat_timeout_ms =
+      EnvMs("HOROVOD_HEARTBEAT_TIMEOUT_MS", "HVD_TPU_HEARTBEAT_TIMEOUT_MS",
+            opts.heartbeat_timeout_ms);
+  opts.abort_grace_ms = EnvMs("HOROVOD_ABORT_GRACE_MS",
+                              "HVD_TPU_ABORT_GRACE_MS", opts.abort_grace_ms);
   return new Engine(std::move(opts));
 }
 
@@ -192,6 +211,31 @@ int hvd_divergence_report(void* e, char* buf, int buflen) {
     w.i64(static_cast<int64_t>(entry.seq));
     w.i64(static_cast<int64_t>(entry.hash));
     w.str(entry.desc);
+  }
+  if (static_cast<int>(w.buf.size()) > buflen) {
+    return -static_cast<int>(w.buf.size()) - 1;
+  }
+  std::memcpy(buf, w.buf.data(), w.buf.size());
+  return static_cast<int>(w.buf.size());
+}
+
+// Serialized peer-failure report (docs/fault_tolerance.md): i32 present
+// (0 = no failure), then {i32 failed_rank, str cause, str detail,
+// i64 last_heard_us, str last_collective}.  Returns bytes written, or
+// -needed-1 when buflen is too small (hvd_next_batch's grow-and-retry
+// convention).
+int hvd_failure_report(void* e, char* buf, int buflen) {
+  hvd::PeerFailureReport r = static_cast<Engine*>(e)->FailureReport();
+  Writer w;
+  if (r.failed_rank < 0 && r.cause.empty()) {
+    w.i32(0);
+  } else {
+    w.i32(1);
+    w.i32(r.failed_rank);
+    w.str(r.cause);
+    w.str(r.detail);
+    w.i64(r.last_heard_us);
+    w.str(r.last_collective);
   }
   if (static_cast<int>(w.buf.size()) > buflen) {
     return -static_cast<int>(w.buf.size()) - 1;

@@ -25,6 +25,8 @@ namespace hvd {
 
 namespace {
 
+constexpr size_t kMaxFrameBytes = 64u << 20;  // 64 MiB sanity cap
+
 bool SendAll(int fd, const void* buf, size_t n) {
   const char* p = static_cast<const char*>(buf);
   while (n > 0) {
@@ -49,17 +51,84 @@ bool RecvAll(int fd, void* buf, size_t n) {
   return true;
 }
 
-bool SendFrame(int fd, const std::string& payload) {
-  uint32_t len = static_cast<uint32_t>(payload.size());
-  return SendAll(fd, &len, 4) && SendAll(fd, payload.data(), payload.size());
+// Blocking read that stays interruptible: polls in bounded slices so a
+// failure recorded by the monitor thread (heartbeat timeout, send error)
+// breaks a read that would otherwise block on a dead peer forever.
+enum class RecvResult { OK, CLOSED, FAILED, INTERRUPTED };
+
+RecvResult RecvSome(int fd, void* buf, size_t n,
+                    const std::atomic<bool>& stop, size_t* got_out) {
+  char* p = static_cast<char*>(buf);
+  size_t got = 0;
+  while (got < n) {
+    if (stop.load()) {
+      *got_out = got;
+      return RecvResult::INTERRUPTED;
+    }
+    pollfd pfd{fd, POLLIN, 0};
+    int pr = ::poll(&pfd, 1, 200);
+    if (pr < 0) {
+      if (errno == EINTR) continue;
+      *got_out = got;
+      return RecvResult::FAILED;
+    }
+    if (pr == 0) continue;
+    ssize_t r = ::recv(fd, p + got, n - got, 0);
+    if (r < 0) {
+      if (errno == EINTR || errno == EAGAIN || errno == EWOULDBLOCK) continue;
+      *got_out = got;
+      return RecvResult::FAILED;
+    }
+    if (r == 0) {
+      *got_out = got;
+      return RecvResult::CLOSED;
+    }
+    got += static_cast<size_t>(r);
+  }
+  *got_out = got;
+  return RecvResult::OK;
 }
 
-bool RecvFrame(int fd, std::string* payload) {
-  uint32_t len = 0;
-  if (!RecvAll(fd, &len, 4)) return false;
-  if (len > (64u << 20)) return false;  // 64 MiB sanity cap
-  payload->resize(len);
-  return len == 0 || RecvAll(fd, payload->data(), len);
+// Advertised protocol version.  HVD_TPU_WIRE_VERSION exists so tests can
+// provoke the handshake's skew rejection without a second build.
+uint8_t WireVersionFromEnv() {
+  const char* v = ::getenv("HVD_TPU_WIRE_VERSION");
+  if (v != nullptr && *v != '\0') {
+    int n = ::atoi(v);
+    if (n > 0 && n < 256) return static_cast<uint8_t>(n);
+  }
+  return kWireVersion;
+}
+
+// HVD_TPU_FAULT_WIRE_* = "<rank>[:<frame>]", gated on the restart-attempt
+// counter exactly like faults.py's process-level injectors.
+TcpControlPlane::WireFaultSpec ParseWireFaultEnv() {
+  using Spec = TcpControlPlane::WireFaultSpec;
+  Spec spec;
+  const char* attempt = ::getenv("HVD_TPU_RESTART_ATTEMPT");
+  const char* gate = ::getenv("HVD_TPU_FAULT_ON_ATTEMPT");
+  long attempt_n = (attempt != nullptr && *attempt) ? ::atol(attempt) : 0;
+  long gate_n = (gate != nullptr && *gate) ? ::atol(gate) : 0;
+  if (attempt_n != gate_n) return spec;
+  const struct {
+    const char* env;
+    Spec::Mode mode;
+  } kinds[] = {
+      {"HVD_TPU_FAULT_WIRE_DROP", Spec::Mode::DROP},
+      {"HVD_TPU_FAULT_WIRE_CORRUPT", Spec::Mode::CORRUPT},
+      {"HVD_TPU_FAULT_WIRE_PARTITION", Spec::Mode::PARTITION},
+      {"HVD_TPU_FAULT_WIRE_HALFCLOSE", Spec::Mode::HALFCLOSE},
+  };
+  for (const auto& k : kinds) {
+    const char* v = ::getenv(k.env);
+    if (v == nullptr || *v == '\0') continue;
+    spec.mode = k.mode;
+    spec.rank = ::atoi(v);
+    const char* colon = std::strchr(v, ':');
+    spec.frame = colon != nullptr ? ::atoll(colon + 1) : 0;
+    return spec;
+  }
+  return spec;
 }
 
 // Rendezvous budget, seconds.  Peers can lag the whole interpreter-boot
@@ -108,6 +177,10 @@ std::unique_ptr<TcpControlPlane> TcpControlPlane::MakeCoordinator(
     int port, int size, std::string* err) {
   std::unique_ptr<TcpControlPlane> cp(new TcpControlPlane());
   cp->coordinator_ = true;
+  cp->rank_ = 0;
+  cp->size_ = size;
+  cp->wire_version_ = WireVersionFromEnv();
+  cp->fault_ = ParseWireFaultEnv();
   cp->listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
   if (cp->listen_fd_ < 0) {
     *err = "socket() failed";
@@ -183,14 +256,46 @@ std::unique_ptr<TcpControlPlane> TcpControlPlane::MakeCoordinator(
     tv.tv_sec = static_cast<time_t>(hello_left.count() / 1000);
     tv.tv_usec = static_cast<suseconds_t>((hello_left.count() % 1000) * 1000);
     ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    // Hardened HELLO: magic + version handshake before the peer is
+    // admitted, so a mixed-build worker (or a stray client) becomes a
+    // structured connect error on BOTH sides, not a mid-job desync.
+    char hdr_buf[kFrameHeaderBytes];
+    FrameHeader hello_hdr;
     std::string hello;
     int32_t rank = -1;
-    bool hello_ok = RecvFrame(fd, &hello) && hello.size() == 4;
+    bool hello_ok = RecvAll(fd, hdr_buf, kFrameHeaderBytes);
     timeval zero{};
     ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &zero, sizeof(zero));
-    if (!hello_ok) {
+    if (hello_ok) DecodeFrameHeader(hdr_buf, &hello_hdr);
+    if (!hello_ok || hello_hdr.magic != kFrameMagic) {
       ::close(fd);  // not yet registered: the destructor can't release it
-      *err = "bad hello";
+      *err = "bad hello: connecting peer did not speak the hardened frame "
+             "protocol (corrupted stream or mixed-build peer)";
+      return nullptr;
+    }
+    if (hello_hdr.version != cp->wire_version_) {
+      std::string skew =
+          "protocol version skew: coordinator speaks v" +
+          std::to_string(cp->wire_version_) + " but a connecting worker "
+          "speaks v" + std::to_string(hello_hdr.version) +
+          " — all ranks must run the same horovod_tpu build";
+      cp->SendTypedFrame(fd, FrameType::HELLO_ACK, skew, -1);
+      ::close(fd);
+      *err = skew;
+      return nullptr;
+    }
+    hello_ok = hello_hdr.type == static_cast<uint8_t>(FrameType::HELLO) &&
+               hello_hdr.payload_len == 4;
+    if (hello_ok) {
+      hello.resize(4);
+      ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+      hello_ok = RecvAll(fd, hello.data(), 4) &&
+                 Crc32(hello.data(), 4) == hello_hdr.crc32;
+      ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &zero, sizeof(zero));
+    }
+    if (!hello_ok) {
+      ::close(fd);
+      *err = "bad hello (truncated or corrupt handshake frame)";
       return nullptr;
     }
     std::memcpy(&rank, hello.data(), 4);
@@ -200,7 +305,14 @@ std::unique_ptr<TcpControlPlane> TcpControlPlane::MakeCoordinator(
       return nullptr;
     }
     cp->worker_fds_[rank - 1] = fd;
+    if (!cp->SendTypedFrame(fd, FrameType::HELLO_ACK, "", rank)) {
+      *err = "hello ack send failed to rank " + std::to_string(rank);
+      return nullptr;
+    }
   }
+  cp->last_rx_.assign(cp->worker_fds_.size(),
+                      std::chrono::steady_clock::now());
+  cp->failed_.store(false);  // handshake sends must not pre-arm a failure
   return cp;
 }
 
@@ -208,6 +320,9 @@ std::unique_ptr<TcpControlPlane> TcpControlPlane::MakeWorker(
     const std::string& host, int port, int rank, std::string* err) {
   std::unique_ptr<TcpControlPlane> cp(new TcpControlPlane());
   cp->coordinator_ = false;
+  cp->rank_ = rank;
+  cp->wire_version_ = WireVersionFromEnv();
+  cp->fault_ = ParseWireFaultEnv();
   int one = 1;
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
@@ -248,10 +363,56 @@ std::unique_ptr<TcpControlPlane> TcpControlPlane::MakeWorker(
   std::string hello(4, '\0');
   int32_t r32 = rank;
   std::memcpy(hello.data(), &r32, 4);
-  if (!SendFrame(cp->sock_, hello)) {
+  if (!cp->SendTypedFrame(cp->sock_, FrameType::HELLO, hello, 0)) {
     *err = "hello send failed";
     return nullptr;
   }
+  // Await the HELLO_ACK: empty payload = admitted; non-empty = the
+  // coordinator's structured rejection (version skew and friends).  The
+  // read shares what remains of the rendezvous budget.
+  auto ack_left = std::chrono::duration_cast<std::chrono::milliseconds>(
+      deadline - std::chrono::steady_clock::now());
+  timeval tv{};
+  tv.tv_sec = static_cast<time_t>(std::max<long long>(ack_left.count(), 100) /
+                                  1000);
+  tv.tv_usec = static_cast<suseconds_t>(
+      (std::max<long long>(ack_left.count(), 100) % 1000) * 1000);
+  ::setsockopt(cp->sock_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  char hdr_buf[kFrameHeaderBytes];
+  FrameHeader ack;
+  if (!RecvAll(cp->sock_, hdr_buf, kFrameHeaderBytes)) {
+    *err = "no hello ack from coordinator (dead coordinator, or a "
+           "pre-handshake build on the other side)";
+    return nullptr;
+  }
+  DecodeFrameHeader(hdr_buf, &ack);
+  if (ack.magic != kFrameMagic) {
+    *err = "hello ack had a bad frame magic — corrupted stream or "
+           "mixed-build coordinator";
+    return nullptr;
+  }
+  std::string ack_body(ack.payload_len, '\0');
+  if (ack.payload_len > kMaxFrameBytes ||
+      (ack.payload_len > 0 &&
+       !RecvAll(cp->sock_, ack_body.data(), ack_body.size()))) {
+    *err = "truncated hello ack";
+    return nullptr;
+  }
+  if (ack.version != cp->wire_version_) {
+    *err = "protocol version skew with the coordinator: this rank speaks v" +
+           std::to_string(cp->wire_version_) + ", coordinator speaks v" +
+           std::to_string(ack.version) +
+           (ack_body.empty() ? "" : " (" + ack_body + ")");
+    return nullptr;
+  }
+  if (!ack_body.empty()) {
+    *err = ack_body;  // coordinator's structured rejection
+    return nullptr;
+  }
+  timeval zero{};
+  ::setsockopt(cp->sock_, SOL_SOCKET, SO_RCVTIMEO, &zero, sizeof(zero));
+  cp->last_rx_.assign(1, std::chrono::steady_clock::now());
+  cp->failed_.store(false);
   return cp;
 }
 
@@ -262,13 +423,290 @@ TcpControlPlane::~TcpControlPlane() {
   if (listen_fd_ >= 0) ::close(listen_fd_);
 }
 
+// ---------------------------------------------------------------------------
+// Hardened frame I/O + liveness (docs/fault_tolerance.md)
+// ---------------------------------------------------------------------------
+
+void TcpControlPlane::NoteRx(int peer_rank) {
+  int idx = PeerIndex(peer_rank);
+  std::lock_guard<std::mutex> l(state_mu_);
+  if (idx >= 0 && static_cast<size_t>(idx) < last_rx_.size()) {
+    last_rx_[static_cast<size_t>(idx)] = std::chrono::steady_clock::now();
+  }
+}
+
+double TcpControlPlane::SecondsSinceRx(int peer_rank) const {
+  int idx = PeerIndex(peer_rank);
+  std::lock_guard<std::mutex> l(state_mu_);
+  if (idx < 0 || static_cast<size_t>(idx) >= last_rx_.size()) return 0;
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now() -
+             last_rx_[static_cast<size_t>(idx)])
+      .count();
+}
+
+bool TcpControlPlane::PartitionActive() const {
+  return fault_.mode == WireFaultSpec::Mode::PARTITION &&
+         fault_.rank == rank_ && frames_sent_.load() >= fault_.frame;
+}
+
+void TcpControlPlane::RecordFailure(int peer_rank, const char* cause,
+                                    std::string detail) {
+  double silent = SecondsSinceRx(peer_rank);
+  std::lock_guard<std::mutex> l(state_mu_);
+  if (failed_.load()) return;  // first observation wins
+  failure_.failed_rank = peer_rank;
+  failure_.cause = cause;
+  failure_.detail = std::move(detail);
+  failure_.last_heard_us = static_cast<int64_t>(silent * 1e6);
+  failed_.store(true);
+}
+
+void TcpControlPlane::RecordAbort(const PeerFailureReport& report) {
+  std::lock_guard<std::mutex> l(state_mu_);
+  if (failed_.load()) return;
+  failure_ = report;
+  if (failure_.detail.empty()) {
+    failure_.detail = "abort broadcast by the coordinator";
+  } else {
+    failure_.detail += " (abort relayed by the coordinator)";
+  }
+  failed_.store(true);
+}
+
+bool TcpControlPlane::GetFailure(PeerFailureReport* out) const {
+  std::lock_guard<std::mutex> l(state_mu_);
+  if (!failed_.load()) return false;
+  *out = failure_;
+  return true;
+}
+
+bool TcpControlPlane::SendTypedFrame(int fd, FrameType type,
+                                     const std::string& payload,
+                                     int peer_rank) {
+  long long seq = frames_sent_.fetch_add(1);
+  const bool faulty = fault_.mode != WireFaultSpec::Mode::NONE &&
+                      fault_.rank == rank_ && seq >= fault_.frame;
+  if (faulty) {
+    switch (fault_.mode) {
+      case WireFaultSpec::Mode::DROP:
+      case WireFaultSpec::Mode::PARTITION:
+        return true;  // the frame vanishes on the (simulated) wire
+      case WireFaultSpec::Mode::HALFCLOSE:
+        if (!halfclosed_.exchange(true)) {
+          // Close our write side once: peers see a clean EOF mid-stream
+          // while we keep reading — the classic half-open failure.
+          if (sock_ >= 0) ::shutdown(sock_, SHUT_WR);
+          for (int wfd : worker_fds_) {
+            if (wfd >= 0) ::shutdown(wfd, SHUT_WR);
+          }
+        }
+        return true;  // swallowed: the write side is gone
+      default:
+        break;
+    }
+  }
+  FrameHeader h;
+  h.version = wire_version_;
+  h.type = static_cast<uint8_t>(type);
+  h.payload_len = static_cast<uint32_t>(payload.size());
+  h.crc32 = Crc32(payload.data(), payload.size());
+  const std::string* body = &payload;
+  std::string mangled;
+  if (faulty && fault_.mode == WireFaultSpec::Mode::CORRUPT &&
+      !corrupt_fired_.exchange(true)) {
+    // Flip payload bits AFTER the checksum was computed: the receiver must
+    // catch the mismatch, never deserialize the garbage.
+    mangled = payload;
+    if (mangled.empty()) {
+      h.crc32 ^= 0xDEADBEEFu;  // empty payload: corrupt the checksum itself
+    } else {
+      mangled[mangled.size() / 2] =
+          static_cast<char>(mangled[mangled.size() / 2] ^ 0x5A);
+    }
+    body = &mangled;
+  }
+  char hdr[kFrameHeaderBytes];
+  EncodeFrameHeader(h, hdr);
+  std::lock_guard<std::mutex> l(send_mu_);
+  if (!SendAll(fd, hdr, kFrameHeaderBytes) ||
+      !SendAll(fd, body->data(), body->size())) {
+    RecordFailure(peer_rank, "connection_lost",
+                  "control-plane send to rank " + std::to_string(peer_rank) +
+                      " failed (" + std::strerror(errno) + ")");
+    return false;
+  }
+  return true;
+}
+
+bool TcpControlPlane::RecvDataFrame(int fd, int peer_rank, FrameType expect,
+                                    std::string* payload) {
+  for (;;) {
+    if (failed_.load()) return false;
+    char hdr_buf[kFrameHeaderBytes];
+    size_t got = 0;
+    RecvResult rr = RecvSome(fd, hdr_buf, kFrameHeaderBytes, failed_, &got);
+    if (rr == RecvResult::INTERRUPTED) return false;
+    if (rr != RecvResult::OK) {
+      RecordFailure(
+          peer_rank, "connection_reset",
+          rr == RecvResult::CLOSED
+              ? (got == 0 ? "rank " + std::to_string(peer_rank) +
+                                " closed the control-plane connection (EOF)"
+                          : "control-plane stream from rank " +
+                                std::to_string(peer_rank) +
+                                " truncated mid-frame-header")
+              : "control-plane recv from rank " + std::to_string(peer_rank) +
+                    " failed (" + std::strerror(errno) + ")");
+      return false;
+    }
+    FrameHeader h;
+    DecodeFrameHeader(hdr_buf, &h);
+    if (h.magic != kFrameMagic) {
+      RecordFailure(peer_rank, "frame_desync",
+                    "bad frame magic from rank " + std::to_string(peer_rank) +
+                        " — corrupted stream or mixed-build peer");
+      return false;
+    }
+    if (h.version != wire_version_) {
+      RecordFailure(peer_rank, "version_skew",
+                    "protocol version skew with rank " +
+                        std::to_string(peer_rank) + ": local v" +
+                        std::to_string(wire_version_) + ", peer v" +
+                        std::to_string(h.version));
+      return false;
+    }
+    if (h.payload_len > kMaxFrameBytes) {
+      RecordFailure(peer_rank, "frame_corrupt",
+                    "absurd frame length from rank " +
+                        std::to_string(peer_rank) + " (" +
+                        std::to_string(h.payload_len) + " bytes)");
+      return false;
+    }
+    std::string body(h.payload_len, '\0');
+    if (h.payload_len > 0) {
+      rr = RecvSome(fd, body.data(), body.size(), failed_, &got);
+      if (rr == RecvResult::INTERRUPTED) return false;
+      if (rr != RecvResult::OK) {
+        RecordFailure(peer_rank, "connection_reset",
+                      "control-plane stream from rank " +
+                          std::to_string(peer_rank) +
+                          " truncated mid-frame (got " + std::to_string(got) +
+                          " of " + std::to_string(h.payload_len) + " bytes)");
+        return false;
+      }
+    }
+    if (Crc32(body.data(), body.size()) != h.crc32) {
+      RecordFailure(peer_rank, "frame_corrupt",
+                    "frame CRC mismatch from rank " +
+                        std::to_string(peer_rank) +
+                        " (wire corruption; frame type " +
+                        std::to_string(h.type) + ", " +
+                        std::to_string(h.payload_len) + " bytes)");
+      return false;
+    }
+    if (PartitionActive()) continue;  // simulated partition: nothing lands
+    NoteRx(peer_rank);
+    FrameType t = static_cast<FrameType>(h.type);
+    if (t == FrameType::HEARTBEAT) continue;
+    if (t == FrameType::ABORT) {
+      PeerFailureReport report;
+      if (Deserialize(body.data(), body.size(), &report)) {
+        RecordAbort(report);
+      } else {
+        RecordFailure(peer_rank, "frame_corrupt",
+                      "undecodable ABORT frame from rank " +
+                          std::to_string(peer_rank));
+      }
+      return false;
+    }
+    if (t != expect) {
+      RecordFailure(peer_rank, "frame_desync",
+                    "unexpected frame type " + std::to_string(h.type) +
+                        " from rank " + std::to_string(peer_rank));
+      return false;
+    }
+    *payload = std::move(body);
+    return true;
+  }
+}
+
+bool TcpControlPlane::HeartbeatTick(double timeout_s) {
+  if (failed_.load()) return true;
+  struct Peer {
+    int fd;
+    int rank;
+  };
+  std::vector<Peer> peers;
+  if (coordinator_) {
+    for (size_t i = 0; i < worker_fds_.size(); ++i) {
+      peers.push_back({worker_fds_[i], static_cast<int>(i) + 1});
+    }
+  } else {
+    peers.push_back({sock_, 0});
+  }
+  for (const Peer& p : peers) {
+    if (p.fd < 0) continue;
+    SendTypedFrame(p.fd, FrameType::HEARTBEAT, "", p.rank);
+    if (failed_.load()) return true;
+    if (SecondsSinceRx(p.rank) < timeout_s) continue;
+    // Silent past the timeout — but only declare death if the silence is
+    // real.  Bytes sitting unread in the socket buffer mean the peer is
+    // alive and OUR cycle thread is just starved (TSAN/overload): skip.
+    if (!PartitionActive()) {
+      pollfd pfd{p.fd, POLLIN, 0};
+      int pr = ::poll(&pfd, 1, 0);
+      if (pr > 0 && (pfd.revents & POLLIN) != 0) {
+        char probe;
+        ssize_t r = ::recv(p.fd, &probe, 1, MSG_PEEK | MSG_DONTWAIT);
+        if (r > 0) continue;  // frames pending: peer alive, reader starved
+        if (r == 0) {
+          RecordFailure(p.rank, "connection_reset",
+                        "rank " + std::to_string(p.rank) +
+                            " closed the control-plane connection (EOF)");
+          return true;
+        }
+      }
+    }
+    RecordFailure(
+        p.rank, "heartbeat_timeout",
+        "no control-plane frames from rank " + std::to_string(p.rank) +
+            " for " + std::to_string(timeout_s) +
+            "s (HVD_TPU_HEARTBEAT_TIMEOUT_MS)");
+    return true;
+  }
+  return failed_.load();
+}
+
+void TcpControlPlane::AbortPeers(const PeerFailureReport& report) {
+  std::string payload;
+  Serialize(report, &payload);
+  if (coordinator_) {
+    for (size_t i = 0; i < worker_fds_.size(); ++i) {
+      if (worker_fds_[i] < 0) continue;
+      // Best effort, the failed rank included: a half-open peer can still
+      // read, and a dead one just errors the send (already recorded).
+      SendTypedFrame(worker_fds_[i], FrameType::ABORT, payload,
+                     static_cast<int>(i) + 1);
+    }
+  } else if (sock_ >= 0) {
+    SendTypedFrame(sock_, FrameType::ABORT, payload, 0);
+  }
+}
+
 bool TcpControlPlane::Exchange(const RequestList& send, ResponseList* recv) {
   std::string out;
   Serialize(send, &out);
-  if (!SendFrame(sock_, out)) return false;
+  if (!SendTypedFrame(sock_, FrameType::REQUEST, out, 0)) return false;
   std::string in;
-  if (!RecvFrame(sock_, &in)) return false;
-  return Deserialize(in.data(), in.size(), recv);
+  if (!RecvDataFrame(sock_, 0, FrameType::RESPONSE, &in)) return false;
+  if (!Deserialize(in.data(), in.size(), recv)) {
+    RecordFailure(0, "frame_corrupt",
+                  "ResponseList deserialization failed despite a valid "
+                  "checksum (schema skew?)");
+    return false;
+  }
+  return true;
 }
 
 bool TcpControlPlane::Gather(const RequestList& own,
@@ -281,15 +719,19 @@ bool TcpControlPlane::Gather(const RequestList& own,
   // tick cost max(worker latency) + P * frame-copy instead: the
   // sequential-star analog of the reference's tree MPI_Gather
   // (reference operations.cc:1742-1850) without a protocol change.
+  // HEARTBEAT frames interleave with the REQUEST stream and are consumed
+  // here; every violation of the hardened framing becomes a structured
+  // PeerFailureReport naming the worker.
   size_t n = worker_fds_.size();
   all->assign(n + 1, RequestList{});
   (*all)[0] = own;
   if (n == 0) return true;
 
   struct FrameState {
-    uint32_t len = 0;        // payload length once the header is in
+    FrameHeader hdr;
+    char hdr_buf[kFrameHeaderBytes];
     size_t got = 0;          // bytes of the current stage received
-    bool have_len = false;
+    bool have_hdr = false;
     bool done = false;
     std::string buf;
   };
@@ -298,6 +740,7 @@ bool TcpControlPlane::Gather(const RequestList& own,
   std::vector<size_t> owner(n);  // pfds slot -> worker index
   size_t remaining = n;
   while (remaining > 0) {
+    if (failed_.load()) return false;  // monitor thread saw a peer die
     nfds_t live = 0;
     for (size_t i = 0; i < n; ++i) {
       if (st[i].done) continue;
@@ -307,45 +750,116 @@ bool TcpControlPlane::Gather(const RequestList& own,
       owner[live] = i;
       ++live;
     }
-    int pr = ::poll(pfds.data(), live, -1);
+    // Bounded poll so a failure recorded by the monitor thread (heartbeat
+    // timeout on a silent-but-connected worker) interrupts the wait.
+    int pr = ::poll(pfds.data(), live, 200);
     if (pr < 0) {
       if (errno == EINTR) continue;
       return false;
     }
+    if (pr == 0) continue;
     for (nfds_t s = 0; s < live; ++s) {
       if ((pfds[s].revents & (POLLIN | POLLERR | POLLHUP)) == 0) continue;
       size_t i = owner[s];
+      int wrank = static_cast<int>(i) + 1;
       FrameState& f = st[i];
       // Drain what is available without blocking; partial frames keep
       // their state until the fd is readable again.
       for (;;) {
         ssize_t r;
-        if (!f.have_len) {
-          char* p = reinterpret_cast<char*>(&f.len);
-          r = ::recv(worker_fds_[i], p + f.got, 4 - f.got, MSG_DONTWAIT);
+        if (!f.have_hdr) {
+          r = ::recv(worker_fds_[i], f.hdr_buf + f.got,
+                     kFrameHeaderBytes - f.got, MSG_DONTWAIT);
         } else {
           r = ::recv(worker_fds_[i], f.buf.data() + f.got,
-                     f.len - f.got, MSG_DONTWAIT);
+                     f.hdr.payload_len - f.got, MSG_DONTWAIT);
         }
         if (r < 0) {
           if (errno == EINTR) continue;
           if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+          RecordFailure(wrank, "connection_reset",
+                        "control-plane recv from rank " +
+                            std::to_string(wrank) + " failed (" +
+                            std::strerror(errno) + ")");
           return false;
         }
-        if (r == 0) return false;  // peer closed mid-frame
+        if (r == 0) {  // peer closed — mid-frame close is a truncation
+          RecordFailure(
+              wrank, "connection_reset",
+              f.got == 0 && !f.have_hdr
+                  ? "rank " + std::to_string(wrank) +
+                        " closed the control-plane connection (EOF)"
+                  : "control-plane stream from rank " +
+                        std::to_string(wrank) + " truncated mid-frame");
+          return false;
+        }
         f.got += static_cast<size_t>(r);
-        if (!f.have_len) {
-          if (f.got < 4) continue;
-          if (f.len > (64u << 20)) return false;  // 64 MiB sanity cap
-          f.have_len = true;
+        if (!f.have_hdr) {
+          if (f.got < kFrameHeaderBytes) continue;
+          DecodeFrameHeader(f.hdr_buf, &f.hdr);
+          if (f.hdr.magic != kFrameMagic) {
+            RecordFailure(wrank, "frame_desync",
+                          "bad frame magic from rank " +
+                              std::to_string(wrank) +
+                              " — corrupted stream or mixed-build peer");
+            return false;
+          }
+          if (f.hdr.version != wire_version_) {
+            RecordFailure(wrank, "version_skew",
+                          "protocol version skew with rank " +
+                              std::to_string(wrank) + ": local v" +
+                              std::to_string(wire_version_) + ", peer v" +
+                              std::to_string(f.hdr.version));
+            return false;
+          }
+          if (f.hdr.payload_len > kMaxFrameBytes) {
+            RecordFailure(wrank, "frame_corrupt",
+                          "absurd frame length from rank " +
+                              std::to_string(wrank) + " (" +
+                              std::to_string(f.hdr.payload_len) + " bytes)");
+            return false;
+          }
+          f.have_hdr = true;
           f.got = 0;
-          f.buf.resize(f.len);
-          if (f.len > 0) continue;
-        } else if (f.got < f.len) {
+          f.buf.resize(f.hdr.payload_len);
+          if (f.hdr.payload_len > 0) continue;
+        } else if (f.got < f.hdr.payload_len) {
           continue;
         }
-        if (!Deserialize(f.buf.data(), f.buf.size(), &(*all)[i + 1]))
+        // Full frame in hand: checksum, then demultiplex.
+        if (Crc32(f.buf.data(), f.buf.size()) != f.hdr.crc32) {
+          RecordFailure(wrank, "frame_corrupt",
+                        "frame CRC mismatch from rank " +
+                            std::to_string(wrank) +
+                            " (wire corruption; frame type " +
+                            std::to_string(f.hdr.type) + ", " +
+                            std::to_string(f.hdr.payload_len) + " bytes)");
           return false;
+        }
+        FrameType t = static_cast<FrameType>(f.hdr.type);
+        if (PartitionActive()) {  // simulated partition: nothing lands
+          f = FrameState{};
+          continue;
+        }
+        NoteRx(wrank);
+        if (t == FrameType::HEARTBEAT) {
+          f = FrameState{};  // liveness only; keep draining this fd
+          continue;
+        }
+        if (t != FrameType::REQUEST) {
+          RecordFailure(wrank, "frame_desync",
+                        "unexpected frame type " + std::to_string(f.hdr.type) +
+                            " from rank " + std::to_string(wrank));
+          return false;
+        }
+        if (!Deserialize(f.buf.data(), f.buf.size(), &(*all)[i + 1])) {
+          RecordFailure(wrank, "frame_corrupt",
+                        "RequestList deserialization from rank " +
+                            std::to_string(wrank) +
+                            " failed despite a valid checksum (schema "
+                            "skew?)");
+          return false;
+        }
         f.done = true;
         --remaining;
         break;
@@ -358,8 +872,11 @@ bool TcpControlPlane::Gather(const RequestList& own,
 bool TcpControlPlane::Broadcast(const ResponseList& out) {
   std::string payload;
   Serialize(out, &payload);
-  for (int fd : worker_fds_) {
-    if (!SendFrame(fd, payload)) return false;
+  for (size_t i = 0; i < worker_fds_.size(); ++i) {
+    if (!SendTypedFrame(worker_fds_[i], FrameType::RESPONSE, payload,
+                        static_cast<int>(i) + 1)) {
+      return false;
+    }
   }
   return true;
 }

@@ -11,11 +11,13 @@
 // standing in for the reference's MPI_Gather/Gatherv/Bcast.
 #pragma once
 
+#include <atomic>
 #include <chrono>
 #include <deque>
 #include <list>
 #include <map>
 #include <memory>
+#include <mutex>
 #include <set>
 #include <string>
 #include <unordered_map>
@@ -41,6 +43,19 @@ class ControlPlane {
                       std::vector<RequestList>* all) = 0;
   virtual bool Broadcast(const ResponseList& out) = 0;
   virtual bool is_coordinator() const = 0;
+
+  // Liveness hooks, driven by the engine's monitor thread (TCP only; the
+  // loopback plane has no peers).  HeartbeatTick sends a HEARTBEAT frame
+  // to every peer and flags any peer silent for longer than timeout_s;
+  // returns true once a peer failure has been recorded (transport calls
+  // above also record failures — EOF, CRC mismatch, version skew).
+  virtual bool HeartbeatTick(double /*timeout_s*/) { return false; }
+  // Structured cause of the recorded failure; false when none.
+  virtual bool GetFailure(PeerFailureReport* /*out*/) const { return false; }
+  // Coordinator: broadcast an ABORT frame naming the failed rank to every
+  // worker, best effort — survivors fail their pending collectives with
+  // the report instead of waiting out the stall window.
+  virtual void AbortPeers(const PeerFailureReport& /*report*/) {}
 };
 
 // Single-process transport: Exchange/Gather/Broadcast are pass-throughs.
@@ -60,7 +75,14 @@ class LoopbackControlPlane : public ControlPlane {
 };
 
 // TCP transport: coordinator (rank 0) accepts one persistent connection per
-// worker; frames are uint32-length-prefixed serialized messages.
+// worker.  Every frame is hardened (message.h FrameHeader: magic + protocol
+// version + CRC32) with a HELLO/HELLO_ACK version handshake at connect, so
+// corruption, truncation, desync, and mixed-build skew fail fast with a
+// structured error naming the peer instead of hanging or deserializing
+// garbage.  HEARTBEAT frames from the engine's monitor thread interleave
+// with the request/response stream (a per-plane send mutex keeps frames
+// atomic; receive paths demultiplex them), giving both sides a liveness
+// signal that works even when negotiation is blocked on a dead peer.
 class TcpControlPlane : public ControlPlane {
  public:
   // Coordinator: bind+listen on port, accept size-1 workers (identified by a
@@ -78,13 +100,64 @@ class TcpControlPlane : public ControlPlane {
   bool is_coordinator() const override { return coordinator_; }
   int bound_port() const { return port_; }
 
+  bool HeartbeatTick(double timeout_s) override;
+  bool GetFailure(PeerFailureReport* out) const override;
+  void AbortPeers(const PeerFailureReport& report) override;
+
+  // Env-driven wire-level chaos injection (faults.py table;
+  // HVD_TPU_FAULT_WIRE_{DROP,CORRUPT,PARTITION,HALFCLOSE}="<rank>[:<frame>]",
+  // gated on HVD_TPU_RESTART_ATTEMPT == HVD_TPU_FAULT_ON_ATTEMPT like every
+  // other injector).  The named rank misbehaves from its <frame>-th sent
+  // frame on; all other ranks run clean and must detect + abort.
+  struct WireFaultSpec {
+    enum class Mode { NONE, DROP, CORRUPT, PARTITION, HALFCLOSE };
+    Mode mode = Mode::NONE;
+    int rank = -1;
+    long long frame = 0;
+  };
+
  private:
   TcpControlPlane() = default;
+
+  // Frame I/O.  SendTypedFrame is the single choke point for outbound
+  // frames (send mutex + CRC + fault injection); RecvDataFrame reads until
+  // a frame of type `expect` arrives, consuming HEARTBEATs (liveness) and
+  // ABORTs (failure) along the way.  Both record structured failures.
+  bool SendTypedFrame(int fd, FrameType type, const std::string& payload,
+                      int peer_rank);
+  bool RecvDataFrame(int fd, int peer_rank, FrameType expect,
+                     std::string* payload);
+  void RecordFailure(int peer_rank, const char* cause, std::string detail);
+  void RecordAbort(const PeerFailureReport& report);
+  void NoteRx(int peer_rank);
+  double SecondsSinceRx(int peer_rank) const;
+  bool PartitionActive() const;
+  int PeerIndex(int peer_rank) const {
+    return coordinator_ ? peer_rank - 1 : 0;
+  }
+
   bool coordinator_ = false;
+  int rank_ = 0;
+  int size_ = 1;
   int port_ = 0;
   int listen_fd_ = -1;
   int sock_ = -1;                    // worker → coordinator
   std::vector<int> worker_fds_;      // coordinator: index = rank-1
+
+  // One frame on the wire at a time: the monitor thread's heartbeats and
+  // the cycle thread's request/response traffic share each socket.
+  std::mutex send_mu_;
+  // Liveness + failure state (monitor thread vs cycle thread).
+  mutable std::mutex state_mu_;
+  std::vector<std::chrono::steady_clock::time_point> last_rx_;  // peer index
+  PeerFailureReport failure_;
+  std::atomic<bool> failed_{false};
+
+  uint8_t wire_version_ = kWireVersion;  // HVD_TPU_WIRE_VERSION override
+  WireFaultSpec fault_;
+  std::atomic<long long> frames_sent_{0};
+  std::atomic<bool> corrupt_fired_{false};
+  std::atomic<bool> halfclosed_{false};
 };
 
 // Capacity-bounded LRU cache of negotiated responses — the rebuild of the

@@ -35,6 +35,7 @@ Engine::Engine(EngineOptions opts) : opts_(std::move(opts)) {}
 Engine::~Engine() {
   Shutdown();
   if (thread_.joinable()) thread_.join();
+  if (monitor_thread_.joinable()) monitor_thread_.join();
 }
 
 Status Engine::Start(int* bound_port) {
@@ -68,6 +69,11 @@ Status Engine::Start(int* bound_port) {
     if (cache_.enabled()) coordinator_->SetResponseCache(&cache_);
   }
   thread_ = std::thread(&Engine::Loop, this);
+  if (opts_.size > 1 && opts_.heartbeat_ms > 0) {
+    // Peer liveness is only meaningful on the TCP plane; loopback jobs
+    // have no peers to lose.
+    monitor_thread_ = std::thread(&Engine::MonitorLoop, this);
+  }
   return Status::OK();
 }
 
@@ -78,6 +84,7 @@ void Engine::Shutdown() {
   // teardown doesn't wait out the remainder of a cycle tail.
   { std::lock_guard<std::mutex> l(mu_); }
   cycle_cv_.notify_all();
+  monitor_cv_.notify_all();
 }
 
 int64_t Engine::Enqueue(const std::string& name, OpType op, DataType dtype,
@@ -195,9 +202,7 @@ void Engine::RunCycle() {
   if (control_->is_coordinator()) {
     std::vector<RequestList> gathered;
     if (!control_->Gather(own, &gathered)) {
-      FailAllPending(Status::Aborted("control plane gather failed"));
-      stopped_.store(true);
-      exec_cv_.notify_all();
+      HandleTransportFailure("control plane gather failed");
       return;
     }
     {
@@ -243,16 +248,12 @@ void Engine::RunCycle() {
       std::_Exit(opts_.stall_abort_exit_code);
     }
     if (!control_->Broadcast(responses)) {
-      FailAllPending(Status::Aborted("control plane broadcast failed"));
-      stopped_.store(true);
-      exec_cv_.notify_all();
+      HandleTransportFailure("control plane broadcast failed");
       return;
     }
   } else {
     if (!control_->Exchange(own, &responses)) {
-      FailAllPending(Status::Aborted("control plane exchange failed"));
-      stopped_.store(true);
-      exec_cv_.notify_all();
+      HandleTransportFailure("control plane exchange failed");
       return;
     }
   }
@@ -517,6 +518,96 @@ void Engine::HandleDivergence(const std::vector<DivergenceEntry>& entries) {
   exec_cv_.notify_all();
 }
 
+void Engine::MonitorLoop() {
+  for (;;) {
+    {
+      std::unique_lock<std::mutex> l(mu_);
+      WaitWithTimeout(monitor_cv_, l, opts_.heartbeat_ms, [&] {
+        return stopped_.load() || shutdown_requested_.load();
+      });
+    }
+    if (stopped_.load() || shutdown_requested_.load()) return;
+    if (!control_->HeartbeatTick(opts_.heartbeat_timeout_ms / 1000.0)) {
+      continue;
+    }
+    PeerFailureReport report;
+    control_->GetFailure(&report);
+    HandlePeerFailure(std::move(report));
+    return;
+  }
+}
+
+void Engine::HandleTransportFailure(const char* what) {
+  PeerFailureReport report;
+  if (!shutdown_requested_.load() && control_->GetFailure(&report)) {
+    HandlePeerFailure(std::move(report));
+    return;
+  }
+  // Transport failed without a structured cause (or during coordinated
+  // teardown, where closing peers are expected): the pre-heartbeat generic
+  // abort.
+  FailAllPending(Status::Aborted(what));
+  stopped_.store(true);
+  exec_cv_.notify_all();
+}
+
+void Engine::HandlePeerFailure(PeerFailureReport report) {
+  bool expected = false;
+  if (!failure_handled_.compare_exchange_strong(expected, true)) return;
+  {
+    std::lock_guard<std::mutex> l(mu_);
+    if (report.last_collective.empty() && !inflight_.empty()) {
+      report.last_collective = inflight_.begin()->first;
+    }
+    failure_ = report;
+  }
+  std::ostringstream msg;
+  msg << "Peer failure detected: rank " << report.failed_rank << " ("
+      << report.cause << ") — " << report.detail << ".";
+  if (!report.last_collective.empty()) {
+    msg << " Pending collective at detection: '" << report.last_collective
+        << "'.";
+  }
+  msg << " All pending collectives were aborted; hvd.failure_report() has "
+         "the structured report.";
+  std::string text = msg.str();
+  std::fprintf(stderr, "ERROR: horovod_tpu %s\n", text.c_str());
+  std::fflush(stderr);
+  if (timeline_.Initialized()) {
+    // Mark the coordination timeline: every peer-death shows PEER_FAILED;
+    // heartbeat-detected ones get the extra HEARTBEAT_TIMEOUT instant.
+    if (report.cause == "heartbeat_timeout") {
+      timeline_.Instant("control_plane", "HEARTBEAT_TIMEOUT");
+    }
+    timeline_.Instant("control_plane", "PEER_FAILED");
+  }
+  if (control_->is_coordinator()) {
+    // Coordinated abort: survivors must not ride out the stall window
+    // waiting on a peer the coordinator already knows is dead.
+    control_->AbortPeers(failure_);
+  }
+  FailAllPending(Status::PreconditionError(text));
+  stopped_.store(true);
+  exec_cv_.notify_all();
+  cycle_cv_.notify_all();
+  monitor_cv_.notify_all();
+  if (opts_.abort_grace_ms >= 0) {
+    // Restartable abort (the stall-escalation contract): give Python
+    // abort_grace_ms to observe failure_report(), then exit with the
+    // EX_TEMPFAIL code so the launcher's supervision relaunches from the
+    // last checkpoint.  _Exit, not exit: a peer-dead job may have threads
+    // wedged in blocking collectives, and atexit would hang on them.
+    std::this_thread::sleep_for(std::chrono::duration<double, std::milli>(
+        opts_.abort_grace_ms));
+    std::fprintf(stderr,
+                 "ERROR: horovod_tpu aborting after peer failure with "
+                 "restartable exit code %d\n",
+                 opts_.stall_abort_exit_code);
+    std::fflush(stderr);
+    std::_Exit(opts_.stall_abort_exit_code);
+  }
+}
+
 void Engine::FailUnscheduled(const Status& status) {
   std::lock_guard<std::mutex> l(mu_);
   // Tensors inside a dispatched batch (queued for or held by the executor)
@@ -590,6 +681,11 @@ void Engine::SubmitVerify(int64_t seq, uint64_t hash,
 std::vector<DivergenceEntry> Engine::DivergenceReport() {
   std::lock_guard<std::mutex> l(mu_);
   return divergence_;
+}
+
+PeerFailureReport Engine::FailureReport() {
+  std::lock_guard<std::mutex> l(mu_);
+  return failure_;
 }
 
 bool Engine::PollHandle(int64_t handle) {

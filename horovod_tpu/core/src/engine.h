@@ -74,6 +74,21 @@ struct EngineOptions {
   // mismatch — instead of the job stalling until the stall timeout.
   bool verify_schedule = false;
   int verify_interval_ticks = 10;
+  // Control-plane heartbeats (HVD_TPU_HEARTBEAT_MS; docs/fault_tolerance.md
+  // "Fast failure detection").  A monitor thread sends a liveness frame to
+  // every peer each interval and maps socket EOF / ECONNRESET / heartbeat
+  // silence to a structured PeerFailureReport + coordinated abort, so a
+  // SIGKILLed or partitioned rank is detected in ~the interval instead of
+  // the 60 s stall window.  0 disables (multi-process TCP jobs only; the
+  // loopback plane has no peers).
+  double heartbeat_ms = 250.0;
+  double heartbeat_timeout_ms = 10000.0;  // silence = death past this
+  // After a peer failure is handled (collectives failed, report published,
+  // ABORT broadcast) the process exits with stall_abort_exit_code once this
+  // grace elapses — time for Python to observe hvd.failure_report() — so
+  // the PR-1 supervisor restarts the job even if the script is wedged.
+  // < 0: report only, never exit (debugging).
+  double abort_grace_ms = 1000.0;
   std::string timeline_path;      // empty = disabled
   std::string coordinator_host;   // workers (rank>0)
   int coordinator_port = 0;       // 0 = pick ephemeral (coordinator)
@@ -135,6 +150,13 @@ class Engine {
   // schedule is consistent — hvd.divergence_report() in Python.
   std::vector<DivergenceEntry> DivergenceReport();
 
+  // Structured peer-failure report (hvd.failure_report() in Python, the
+  // stall_report()/divergence_report() analog): who died, how the death
+  // was observed (EOF vs heartbeat timeout vs frame corruption), and a
+  // collective that was pending at detection.  failed_rank == -1 while no
+  // peer failure has been detected.
+  PeerFailureReport FailureReport();
+
   // Handle table (reference torch/handle_manager.{h,cc}).
   bool PollHandle(int64_t handle);                 // true = done
   // Block until the handle completes (condvar wait, not a poll loop).
@@ -149,6 +171,20 @@ class Engine {
  private:
   void Loop();
   void RunCycle();
+  // Heartbeat monitor (docs/fault_tolerance.md): periodically pings peers
+  // through the control plane and triggers HandlePeerFailure the moment
+  // one is declared dead — independent of the cycle thread, so detection
+  // works even while negotiation is blocked on the dead peer.
+  void MonitorLoop();
+  // A transport call failed mid-cycle: route the control plane's recorded
+  // failure (if any) through HandlePeerFailure, else fall back to the
+  // generic abort with `what`.
+  void HandleTransportFailure(const char* what);
+  // Idempotent peer-failure endgame: publish the report, broadcast ABORT
+  // (coordinator), fail every pending collective with a CollectiveError
+  // naming the failed rank, emit timeline instants, and — after
+  // abort_grace_ms — exit the process with the restartable code.
+  void HandlePeerFailure(PeerFailureReport report);
   void DispatchResponses(const ResponseList& responses);
   void HandleDivergence(const std::vector<DivergenceEntry>& entries);
   // Coordinated-shutdown teardown: abort tensors still negotiating, but let
@@ -191,13 +227,20 @@ class Engine {
   std::vector<StallEntry> last_stall_;  // guarded by mu_
   std::vector<VerifyEntry> pending_verify_;      // guarded by mu_
   std::vector<DivergenceEntry> divergence_;      // guarded by mu_
+  PeerFailureReport failure_;                    // guarded by mu_
   int64_t verify_tick_ = 0;   // background thread only
   int64_t next_handle_ = 0;
   int64_t next_batch_id_ = 0;
 
   std::atomic<bool> shutdown_requested_{false};
   std::atomic<bool> stopped_{false};
+  // First thread (cycle or monitor) to observe a peer failure wins;
+  // HandlePeerFailure is a no-op for the loser.
+  std::atomic<bool> failure_handled_{false};
   std::thread thread_;
+  // Wakes MonitorLoop out of its heartbeat-interval wait on shutdown.
+  std::condition_variable monitor_cv_;
+  std::thread monitor_thread_;
 };
 
 }  // namespace hvd

@@ -253,4 +253,72 @@ bool Deserialize(const char* data, size_t len, ResponseList* out) {
   return !r.fail;
 }
 
+// ---------------------------------------------------------------------------
+// Hardened framing: header codec + CRC32 + PeerFailureReport
+// ---------------------------------------------------------------------------
+
+void EncodeFrameHeader(const FrameHeader& h, char out[]) {
+  std::memcpy(out + 0, &h.magic, 4);
+  out[4] = static_cast<char>(h.version);
+  out[5] = static_cast<char>(h.type);
+  std::memcpy(out + 6, &h.flags, 2);
+  std::memcpy(out + 8, &h.payload_len, 4);
+  std::memcpy(out + 12, &h.crc32, 4);
+}
+
+void DecodeFrameHeader(const char in[], FrameHeader* h) {
+  std::memcpy(&h->magic, in + 0, 4);
+  h->version = static_cast<uint8_t>(in[4]);
+  h->type = static_cast<uint8_t>(in[5]);
+  std::memcpy(&h->flags, in + 6, 2);
+  std::memcpy(&h->payload_len, in + 8, 4);
+  std::memcpy(&h->crc32, in + 12, 4);
+}
+
+namespace {
+
+struct Crc32Table {
+  uint32_t t[256];
+  Crc32Table() {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k) {
+        c = (c & 1u) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      }
+      t[i] = c;
+    }
+  }
+};
+
+}  // namespace
+
+uint32_t Crc32(const void* data, size_t len) {
+  static const Crc32Table table;
+  const unsigned char* p = static_cast<const unsigned char*>(data);
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < len; ++i) {
+    c = table.t[(c ^ p[i]) & 0xFFu] ^ (c >> 8);
+  }
+  return c ^ 0xFFFFFFFFu;
+}
+
+void Serialize(const PeerFailureReport& in, std::string* out) {
+  Writer w{out};
+  w.i32(in.failed_rank);
+  w.str(in.cause);
+  w.str(in.detail);
+  w.i64(in.last_heard_us);
+  w.str(in.last_collective);
+}
+
+bool Deserialize(const char* data, size_t len, PeerFailureReport* out) {
+  Reader r{data, len};
+  out->failed_rank = r.i32();
+  out->cause = r.str();
+  out->detail = r.str();
+  out->last_heard_us = r.i64();
+  out->last_collective = r.str();
+  return !r.fail;
+}
+
 }  // namespace hvd

@@ -136,4 +136,62 @@ bool Deserialize(const char* data, size_t len, RequestList* out);
 void Serialize(const ResponseList& in, std::string* out);
 bool Deserialize(const char* data, size_t len, ResponseList* out);
 
+// ---------------------------------------------------------------------------
+// Hardened wire framing (docs/fault_tolerance.md "Fast failure detection").
+//
+// Every TCP control-plane frame is {FrameHeader, payload}: magic + protocol
+// version + type + payload length + CRC32.  A corrupted, truncated, or
+// desynced stream — or a mixed-build peer speaking a different protocol —
+// fails fast with a structured error naming the peer instead of
+// deserializing garbage or hanging (the bare length-prefixed frames this
+// replaces had no way to tell).
+// ---------------------------------------------------------------------------
+
+constexpr uint32_t kFrameMagic = 0x48564446;  // "FDVH" on the wire
+constexpr uint8_t kWireVersion = 1;
+
+enum class FrameType : uint8_t {
+  HELLO = 1,      // worker -> coordinator at connect: {i32 rank}
+  HELLO_ACK = 2,  // coordinator -> worker: empty = accepted, else error text
+  REQUEST = 3,    // RequestList (worker -> coordinator, every cycle)
+  RESPONSE = 4,   // ResponseList (coordinator -> workers)
+  HEARTBEAT = 5,  // empty liveness frame (monitor threads, both directions)
+  ABORT = 6,      // PeerFailureReport: coordinated job abort
+};
+
+// 16-byte little-endian header preceding every frame payload.
+struct FrameHeader {
+  uint32_t magic = kFrameMagic;
+  uint8_t version = kWireVersion;
+  uint8_t type = 0;
+  uint16_t flags = 0;  // reserved
+  uint32_t payload_len = 0;
+  uint32_t crc32 = 0;  // CRC-32 (IEEE) of the payload bytes
+};
+constexpr size_t kFrameHeaderBytes = 16;
+
+void EncodeFrameHeader(const FrameHeader& h, char out[/*16*/]);
+// Byte-decode only — field validation is the caller's (it knows the peer).
+void DecodeFrameHeader(const char in[/*16*/], FrameHeader* h);
+
+// CRC-32 (IEEE 802.3, reflected, poly 0xEDB88320) — the checksum in every
+// frame header.
+uint32_t Crc32(const void* data, size_t len);
+
+// Structured peer-failure record (docs/fault_tolerance.md): who died, how
+// the death was observed, and what the job was doing.  Broadcast to
+// survivors in ABORT frames and surfaced as hvd.failure_report().
+struct PeerFailureReport {
+  int32_t failed_rank = -1;       // -1 = no failure recorded
+  std::string cause;              // "connection_reset" | "heartbeat_timeout"
+                                  // | "frame_corrupt" | "version_skew"
+                                  // | "frame_desync" | "connection_lost"
+  std::string detail;             // human-readable context
+  int64_t last_heard_us = -1;     // silence before detection (-1 unknown)
+  std::string last_collective;    // a collective pending at detection time
+};
+
+void Serialize(const PeerFailureReport& in, std::string* out);
+bool Deserialize(const char* data, size_t len, PeerFailureReport* out);
+
 }  // namespace hvd

@@ -22,6 +22,18 @@ Injectors (all opt-in; absent env == no faults):
 * ``HVD_TPU_FAULT_CORRUPT_STEP`` — after checkpoint ``step`` commits,
   rank 0 overwrites part of its payload with garbage (bit-rot / torn
   upload); proves restore falls back to the previous complete step.
+* ``HVD_TPU_FAULT_WIRE_{DROP,CORRUPT,PARTITION,HALFCLOSE}`` =
+  ``"<rank>[:<frame>]"`` — wire-level chaos against the TCP control plane
+  (executed natively in core/src/controller.cc; parsed here too so
+  :func:`armed` and tests see one plan).  From its ``<frame>``-th sent
+  control-plane frame on, the named rank DROPs every outgoing frame
+  (one-way partition), CORRUPTs one frame's payload after the CRC is
+  computed (the receiver must reject it, never deserialize garbage),
+  PARTITIONs fully (sends dropped and receives ignored), or HALFCLOSEs
+  its write side (peers see EOF mid-stream while it keeps reading).
+  Every scenario must end in a structured ``hvd.failure_report()`` abort
+  within the heartbeat bound — never a hang (tests/test_failure_detection.py
+  chaos soak).
 * ``HVD_TPU_FAULT_ON_ATTEMPT`` (default 0) — faults fire only when the
   launcher-exported ``HVD_TPU_RESTART_ATTEMPT`` matches, so an injected
   crash consumes exactly one restart and the relaunched job runs clean.
@@ -47,7 +59,12 @@ import time
 
 @dataclasses.dataclass(frozen=True)
 class FaultPlan:
-    """Parsed injector configuration (None field == injector disabled)."""
+    """Parsed injector configuration (None field == injector disabled).
+
+    The ``wire_*`` injectors are ``(rank, frame)`` tuples executed by the
+    native control plane (core/src/controller.cc reads the same env);
+    they appear here so ``armed()``/tooling see the whole plan.
+    """
 
     kill_rank: int | None = None
     kill_step: int | None = None
@@ -58,12 +75,17 @@ class FaultPlan:
     delay_step: int | None = None
     delay_ms: float = 500.0
     corrupt_step: int | None = None
+    wire_drop: tuple[int, int] | None = None
+    wire_corrupt: tuple[int, int] | None = None
+    wire_partition: tuple[int, int] | None = None
+    wire_halfclose: tuple[int, int] | None = None
     on_attempt: int = 0
 
     def any_active(self) -> bool:
         return any(v is not None for v in (
             self.kill_rank, self.stall_rank, self.delay_rank,
-            self.corrupt_step))
+            self.corrupt_step, self.wire_drop, self.wire_corrupt,
+            self.wire_partition, self.wire_halfclose))
 
 
 def _int_env(name: str) -> int | None:
@@ -71,6 +93,16 @@ def _int_env(name: str) -> int | None:
     if raw is None or raw == "":
         return None
     return int(raw)
+
+
+def _wire_env(name: str) -> tuple[int, int] | None:
+    """Parse a wire injector's ``"<rank>[:<frame>]"`` value (frame 0 when
+    omitted) — the format core/src/controller.cc ParseWireFaultEnv reads."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return None
+    rank_s, _, frame_s = raw.partition(":")
+    return int(rank_s), int(frame_s or 0)
 
 
 def _plan_from_env() -> FaultPlan:
@@ -89,6 +121,10 @@ def _plan_from_env() -> FaultPlan:
         delay_step=_int_env("HVD_TPU_FAULT_DELAY_STEP"),
         delay_ms=float(os.environ.get("HVD_TPU_FAULT_DELAY_MS", "500")),
         corrupt_step=_int_env("HVD_TPU_FAULT_CORRUPT_STEP"),
+        wire_drop=_wire_env("HVD_TPU_FAULT_WIRE_DROP"),
+        wire_corrupt=_wire_env("HVD_TPU_FAULT_WIRE_CORRUPT"),
+        wire_partition=_wire_env("HVD_TPU_FAULT_WIRE_PARTITION"),
+        wire_halfclose=_wire_env("HVD_TPU_FAULT_WIRE_HALFCLOSE"),
         on_attempt=_int_env("HVD_TPU_FAULT_ON_ATTEMPT") or 0,
     )
 

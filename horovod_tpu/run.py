@@ -193,6 +193,13 @@ def _run_once(command: list[str], args, attempt: int,
             if not done:
                 time.sleep(0.05)
                 continue
+            # Within one poll batch, examine signal-terminated ranks LAST:
+            # after the first abnormal exit the launcher SIGTERMs the rest,
+            # and a survivor's secondary -15 (rc 143) landing in the same
+            # batch as the originating crash must never be the code the
+            # supervisor sees — restart accounting keys off the originator
+            # (e.g. 137 = SIGKILLed/preempted, 75 = peer-failure abort).
+            done.sort(key=lambda r: (procs[r].returncode < 0, r))
             for r in done:
                 remaining.discard(r)
                 rc = procs[r].returncode

@@ -36,7 +36,28 @@ aliases; the TPU-specific defaults differ where the hardware does:
   --max-restarts N`` relaunches instead of the job hanging forever
   (docs/fault_tolerance.md).  0/unset keeps the warn-only reference
   behaviour.
-* ``HVD_TPU_FAULT_*`` — deterministic fault injection (faults.py).
+* ``HVD_TPU_HEARTBEAT_MS`` — control-plane heartbeat interval (default
+  250; 0 disables).  A native monitor thread on every rank pings its peers
+  each interval; socket EOF/RST and heartbeat silence both become a
+  structured peer-failure report (``hvd.failure_report()``), a coordinated
+  abort of every survivor's pending collectives, and — after
+  ``HVD_TPU_ABORT_GRACE_MS`` — the restartable exit, dropping detection of
+  a SIGKILLed/preempted rank from the 60 s stall window to sub-second
+  (docs/fault_tolerance.md "Fast failure detection").
+* ``HVD_TPU_HEARTBEAT_TIMEOUT_MS`` — silence past this (default 10000)
+  declares a still-connected peer dead (network partition, wedged host).
+  Only consulted when nothing is waiting unread in the socket buffer, so a
+  merely CPU-starved job is never declared dead.
+* ``HVD_TPU_ABORT_GRACE_MS`` — delay (default 1000) between a peer-failure
+  abort and the process's restartable exit (code 75), giving training code
+  time to observe ``hvd.failure_report()``.  Negative: report only, never
+  exit.
+* ``HVD_TPU_WIRE_VERSION`` — testing override of the advertised hardened-
+  frame protocol version (core/src/message.h); mismatched peers are
+  rejected at the connect handshake with a structured version-skew error.
+* ``HVD_TPU_FAULT_*`` — deterministic fault injection (faults.py),
+  including the wire-level chaos injectors
+  ``HVD_TPU_FAULT_WIRE_{DROP,CORRUPT,PARTITION,HALFCLOSE}="<rank>[:<frame>]"``.
 """
 
 from __future__ import annotations
@@ -107,6 +128,33 @@ def stall_abort_seconds() -> float:
 def stall_abort_exit_code() -> int:
     raw = _get("STALL_ABORT_EXIT_CODE")
     return int(raw) if raw else STALL_ABORT_EXIT_CODE
+
+
+DEFAULT_HEARTBEAT_MS = 250.0
+DEFAULT_HEARTBEAT_TIMEOUT_MS = 10000.0
+DEFAULT_ABORT_GRACE_MS = 1000.0
+
+
+def heartbeat_ms() -> float:
+    """Control-plane heartbeat interval (``HVD_TPU_HEARTBEAT_MS``; 0
+    disables peer-death detection).  Read natively in core/src/c_api.cc;
+    this accessor exists for tests and tooling that reason about bounds."""
+    raw = _get("HEARTBEAT_MS")
+    return float(raw) if raw not in (None, "") else DEFAULT_HEARTBEAT_MS
+
+
+def heartbeat_timeout_ms() -> float:
+    """Heartbeat-silence death threshold (``HVD_TPU_HEARTBEAT_TIMEOUT_MS``)."""
+    raw = _get("HEARTBEAT_TIMEOUT_MS")
+    return float(raw) if raw not in (None, "") \
+        else DEFAULT_HEARTBEAT_TIMEOUT_MS
+
+
+def abort_grace_ms() -> float:
+    """Grace between a peer-failure abort and the restartable process exit
+    (``HVD_TPU_ABORT_GRACE_MS``; negative = report only, never exit)."""
+    raw = _get("ABORT_GRACE_MS")
+    return float(raw) if raw not in (None, "") else DEFAULT_ABORT_GRACE_MS
 
 
 def hierarchical_allreduce() -> bool:

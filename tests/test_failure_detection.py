@@ -1,0 +1,501 @@
+"""Fast failure detection: control-plane heartbeats, hardened wire frames,
+and wire-level chaos injection (docs/fault_tolerance.md).
+
+The stall detector needs its full 60 s window to notice a dead peer; the
+heartbeat layer (core/src/controller.cc + engine.cc MonitorLoop) maps
+socket EOF / heartbeat silence / frame corruption to a structured
+``hvd.failure_report()``, a coordinated ABORT broadcast, and a restartable
+exit (75) in well under the acceptance bound of 2 s.  Children here are
+engine-only (numpy + ctypes, no jax import) so every scenario stays cheap
+enough for the tier-1 budget.
+"""
+
+import os
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import textwrap
+import time
+import zlib
+
+import pytest
+
+from _timing import scaled
+from _tsan import tsan_runtime
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Tight, test-scale heartbeat tuning: detection well inside the bound but
+# with enough slack for a loaded 1-2 core CI box.
+FAST_HB = {
+    "HVD_TPU_HEARTBEAT_MS": "50",
+    "HVD_TPU_HEARTBEAT_TIMEOUT_MS": str(int(scaled(800))),
+    "HVD_TPU_ABORT_GRACE_MS": "300",
+    "HVD_TPU_CONNECT_TIMEOUT": str(scaled(60)),
+}
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# argv = [rank, port, nprocs].  Streams collectives forever; on the
+# coordinated peer-failure abort it prints the structured report and lets
+# the engine's grace _Exit(75) decide the exit code (the acceptance
+# contract: survivors EXIT 75, they don't just observe the error).
+WORKER = textwrap.dedent("""
+    import sys, time
+    import numpy as np
+    from horovod_tpu.core.engine import NativeEngine, OP_ALLREDUCE, \\
+        CollectiveError
+    from horovod_tpu.core.executors import local_executor
+
+    rank, port, n = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    eng = NativeEngine(rank, n, executor=local_executor,
+                       coordinator_host="127.0.0.1", coordinator_port=port,
+                       cycle_time_ms=2.0)
+    i = 0
+    try:
+        while True:
+            h = eng.enqueue(f"s{i}", np.ones(8, np.float32), OP_ALLREDUCE)
+            eng.synchronize(h, timeout_s=120.0)
+            i += 1
+            if i == 5:
+                print(f"RANK{rank} STEADY", flush=True)
+    except CollectiveError:
+        print(f"RANK{rank} REPORT={eng.failure_report()!r}", flush=True)
+        time.sleep(30)  # the engine's abort grace must _Exit(75) us
+    print(f"RANK{rank} FELL-THROUGH", flush=True)
+""")
+
+
+def _spawn(script, nprocs, extra_env, port=None):
+    port = port or _free_port()
+    env = {**os.environ, "PYTHONPATH": REPO, **FAST_HB, **extra_env}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", script, str(r), str(port), str(nprocs)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=REPO)
+        for r in range(nprocs)
+    ]
+    return procs, port
+
+
+def _wait_steady(proc, deadline):
+    lines = []
+    for line in proc.stdout:
+        lines.append(line)
+        if "STEADY" in line:
+            return lines
+        assert time.monotonic() < deadline, "".join(lines[-30:])
+    raise AssertionError("stream ended early:\n" + "".join(lines[-30:]))
+
+
+def _drain(procs, timeout):
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out or "")
+    return outs
+
+
+def test_sigkill_peer_detected_fast_with_report_and_exit_75():
+    """The acceptance scenario minus the launcher: SIGKILL a non-zero rank
+    mid-stream in a 3-process job; BOTH survivors (the coordinator via
+    socket EOF, the other worker via the coordinated ABORT broadcast) exit
+    75 with a failure_report naming the failed rank — well under the 2 s
+    bound, vs the >= 60 s stall window."""
+    procs, _ = _spawn(WORKER, 3, {})
+    try:
+        deadline = time.monotonic() + scaled(60)
+        head = [_wait_steady(p, deadline) for p in procs]
+        procs[2].kill()
+        t_kill = time.monotonic()
+        outs = _drain(procs, timeout=scaled(30))
+        detect_s = time.monotonic() - t_kill
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    # Survivors: restartable exit, structured report naming rank 2.
+    assert procs[0].returncode == 75, (procs[0].returncode, outs[0][-2000:])
+    assert procs[1].returncode == 75, (procs[1].returncode, outs[1][-2000:])
+    for r in (0, 1):
+        full = "".join(head[r]) + outs[r]
+        assert "'failed_rank': 2" in full, full[-2000:]
+        assert "REPORT=" in full and "None" not in full.split("REPORT=")[1][:8]
+    # Kill -> both survivors dead, report in hand: the acceptance bound is
+    # 2 s wall; detection itself is EOF-instant + the 0.3 s abort grace.
+    assert detect_s <= scaled(4.0), detect_s
+
+
+STALL_WORKER = textwrap.dedent("""
+    import sys, time
+    import numpy as np
+    from horovod_tpu.core.engine import NativeEngine, OP_ALLREDUCE
+    from horovod_tpu.core.executors import local_executor
+
+    rank, port, n = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    eng = NativeEngine(rank, n, executor=local_executor,
+                       coordinator_host="127.0.0.1", coordinator_port=port,
+                       cycle_time_ms=2.0)
+    if rank == 0:
+        # Only rank 0 announces: rank 1 is LIVE (socket + heartbeats
+        # healthy) but silent — the HVD_TPU_FAULT_STALL_RANK shape.  This
+        # must stay a STALL, never a peer failure.
+        eng.enqueue("lonely", np.ones(4, np.float32), OP_ALLREDUCE)
+        for _ in range(60):
+            time.sleep(0.25)
+            if eng.stall_report():
+                break
+        print(f"RANK0 STALL={eng.stall_report()!r}", flush=True)
+        print(f"RANK0 FAILURE={eng.failure_report()!r}", flush=True)
+        for _ in range(160):  # now ride the stall-abort escalation
+            time.sleep(0.25)
+        print("RANK0 SURVIVED", flush=True)  # must never be reached
+    else:
+        for _ in range(200):
+            time.sleep(0.25)
+""")
+
+
+def test_live_but_silent_rank_stalls_does_not_trip_peer_failure():
+    """Heartbeats must not swallow the stall detector: a rank whose engine
+    is healthy but which never announces the collective produces
+    stall_report() and the stall-abort escalation — failure_report() stays
+    None, because nobody died (the two reports separate 'peer dead' from
+    'peer alive but diverged/stuck')."""
+    procs, _ = _spawn(STALL_WORKER, 2, {
+        "HOROVOD_STALL_WARNING_TIME": "0.4",
+        "HVD_TPU_STALL_ABORT_SECONDS": str(scaled(2.0)),
+        # Heartbeats tight so a false peer-death would fire well before
+        # the stall escalation if the distinction were broken.
+        "HVD_TPU_HEARTBEAT_MS": "50",
+        "HVD_TPU_HEARTBEAT_TIMEOUT_MS": "600",
+    })
+    outs = _drain(procs, timeout=scaled(60))
+    assert procs[0].returncode == 75, (procs[0].returncode, outs[0][-2000:])
+    assert "STALL=[('lonely', [1])]" in outs[0], outs[0][-2000:]
+    assert "FAILURE=None" in outs[0], outs[0][-2000:]
+    assert "SURVIVED" not in outs[0]
+    assert "HVD_TPU_STALL_ABORT_SECONDS" in outs[0], outs[0][-2000:]
+
+
+def test_wire_corrupt_frame_rejected_with_structured_report():
+    """CRC-corruption injector (satellite): rank 1 corrupts one frame's
+    payload after the checksum is computed; the coordinator must reject it
+    (frame_corrupt naming rank 1), abort the job, and relay the report to
+    the corrupting rank — never deserialize the garbage."""
+    procs, _ = _spawn(WORKER, 2, {"HVD_TPU_FAULT_WIRE_CORRUPT": "1:40"})
+    t0 = time.monotonic()
+    outs = _drain(procs, timeout=scaled(40))
+    dt = time.monotonic() - t0
+    assert procs[0].returncode == 75, (procs[0].returncode, outs[0][-2000:])
+    assert procs[1].returncode == 75, (procs[1].returncode, outs[1][-2000:])
+    assert "'cause': 'frame_corrupt'" in outs[0], outs[0][-2000:]
+    assert "'failed_rank': 1" in outs[0], outs[0][-2000:]
+    assert "CRC mismatch" in outs[0], outs[0][-2000:]
+    assert dt <= scaled(20.0), dt
+
+
+def test_truncated_frame_structured_error():
+    """A peer that dies mid-frame (header claims more bytes than ever
+    arrive) must fail the job with a structured truncation report, not a
+    hang or a garbage deserialize.  The fake peer also proves the wire
+    format end-to-end from another language: Python crafts the hardened
+    HELLO (magic/version/CRC32 via zlib) that the C++ side accepts."""
+    port = _free_port()
+    env = {**os.environ, "PYTHONPATH": REPO, **FAST_HB}
+    # Rank 0 alone, expecting one worker — which will be our fake socket.
+    p0 = subprocess.Popen(
+        [sys.executable, "-c", WORKER, "0", str(port), "2"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=REPO)
+
+    def frame(ftype, payload):
+        return struct.pack("<IBBHII", 0x48564446, 1, ftype, 0,
+                           len(payload), zlib.crc32(payload)) + payload
+
+    peer = None
+    deadline = time.monotonic() + scaled(60)
+    while peer is None:  # rank 0's listener comes up after interpreter boot
+        try:
+            peer = socket.create_connection(("127.0.0.1", port), timeout=5)
+        except OSError:
+            assert time.monotonic() < deadline, "coordinator never listened"
+            time.sleep(0.1)
+    try:
+        peer.sendall(frame(1, struct.pack("<i", 1)))       # HELLO rank 1
+        ack = peer.recv(16)
+        assert len(ack) == 16 and ack[:4] == b"FDVH", ack  # HELLO_ACK
+        # REQUEST header promising 64 payload bytes, deliver 8, die.
+        hdr = struct.pack("<IBBHII", 0x48564446, 1, 3, 0, 64,
+                          zlib.crc32(b"x" * 64))
+        peer.sendall(hdr + b"headless")
+        # FIN, not RST: close() with the coordinator's unread heartbeats
+        # still buffered would reset the connection and the peer would see
+        # ECONNRESET instead of the clean truncated-mid-frame EOF under
+        # test.  Half-close the write side and drain until the abort.
+        peer.shutdown(socket.SHUT_WR)
+        peer.settimeout(scaled(20))
+        try:
+            while peer.recv(4096):
+                pass
+        except OSError:
+            pass
+    finally:
+        peer.close()
+    out0 = _drain([p0], timeout=scaled(40))[0]
+    assert p0.returncode == 75, (p0.returncode, out0[-2000:])
+    assert "truncated mid-frame" in out0, out0[-2000:]
+    assert "'failed_rank': 1" in out0, out0[-2000:]
+
+
+def test_version_skew_rejected_at_connect():
+    """Mixed-build protection: a worker advertising a different protocol
+    version is rejected at the HELLO handshake with a structured error on
+    BOTH sides naming both versions — not a mid-job desync."""
+    BOOT = textwrap.dedent("""
+        import sys
+        from horovod_tpu.core.engine import NativeEngine
+        from horovod_tpu.core.executors import local_executor
+        rank, port, n = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+        try:
+            NativeEngine(rank, n, executor=local_executor,
+                         coordinator_host="127.0.0.1",
+                         coordinator_port=port, cycle_time_ms=2.0)
+            print(f"RANK{rank} STARTED", flush=True)
+        except RuntimeError as e:
+            print(f"RANK{rank} REJECTED: {e}", flush=True)
+    """)
+    port = _free_port()
+    env = {**os.environ, "PYTHONPATH": REPO, **FAST_HB,
+           "HVD_TPU_CONNECT_TIMEOUT": str(scaled(40))}
+    p0 = subprocess.Popen(
+        [sys.executable, "-c", BOOT, "0", str(port), "2"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=REPO)
+    p1 = subprocess.Popen(
+        [sys.executable, "-c", BOOT, "1", str(port), "2"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env={**env, "HVD_TPU_WIRE_VERSION": "9"}, cwd=REPO)
+    outs = _drain([p0, p1], timeout=scaled(90))
+    assert "REJECTED" in outs[0] and "version skew" in outs[0], outs[0]
+    assert "REJECTED" in outs[1] and "version skew" in outs[1], outs[1]
+    assert "speaks v9" in outs[0] and "speaks v1" in outs[0], outs[0]
+
+
+# Every wire-chaos scenario must end in success or a structured abort
+# within the heartbeat bound — never a deadlock.  One subprocess pair per
+# scenario; the seed only varies the injection point so reruns cover
+# different frames without losing determinism within a run.
+CHAOS_SEED = int(os.environ.get("HVD_CHAOS_SEED", "20260804"))
+
+
+@pytest.mark.parametrize("mode", ["KILL", "DROP", "CORRUPT", "PARTITION",
+                                  "HALFCLOSE"])
+def test_chaos_soak_never_hangs(mode):
+    # hash() is per-process randomized; ord-sum keeps the injection point
+    # a pure function of (seed, mode) so a failing scenario replays.
+    frame = 30 + (CHAOS_SEED + sum(map(ord, mode))) % 40
+    extra = {}
+    if mode != "KILL":
+        extra[f"HVD_TPU_FAULT_WIRE_{mode}"] = f"1:{frame}"
+    procs, _ = _spawn(WORKER, 2, extra)
+    try:
+        if mode == "KILL":
+            deadline = time.monotonic() + scaled(60)
+            for p in procs:
+                _wait_steady(p, deadline)
+            procs[1].send_signal(signal.SIGKILL)
+        outs = _drain(procs, timeout=scaled(60))  # bound: never deadlocks
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    # Rank 0 survives every scenario here and must have aborted
+    # structurally with the restartable code.
+    assert procs[0].returncode == 75, (mode, procs[0].returncode,
+                                       outs[0][-2000:])
+    assert "'failed_rank':" in outs[0], (mode, outs[0][-2000:])
+    assert "'cause': '" in outs[0], (mode, outs[0][-2000:])
+    if mode != "KILL":
+        # The misbehaving-but-alive rank is told too (ABORT relay) or
+        # times out on its own (partition) — either way exit 75, no hang.
+        assert procs[1].returncode == 75, (mode, procs[1].returncode,
+                                           outs[1][-2000:])
+
+
+# Launcher end-to-end (jax-free children): injected SIGKILL at a step, the
+# survivor exits 75 via the peer-failure path, and the supervisor
+# relaunches; the relaunched attempt runs clean because injectors key off
+# HVD_TPU_RESTART_ATTEMPT.
+LAUNCHED = textwrap.dedent("""
+    import os, signal, sys, time
+    import numpy as np
+    from horovod_tpu.core.engine import NativeEngine, OP_ALLREDUCE, \\
+        CollectiveError
+    from horovod_tpu.core.executors import local_executor
+    from horovod_tpu import faults
+
+    # Stand in for a training script busy with cleanup: the launcher's
+    # job-abort SIGTERM must not beat the survivor's own peer-failure
+    # report + exit-75 path (the launcher escalates to SIGKILL anyway).
+    signal.signal(signal.SIGTERM, signal.SIG_IGN)
+    rank = int(os.environ["JAX_PROCESS_ID"])
+    n = int(os.environ["JAX_NUM_PROCESSES"])
+    port = int(os.environ["HVD_TPU_COORDINATOR_PORT"])
+    eng = NativeEngine(rank, n, executor=local_executor,
+                       coordinator_host="127.0.0.1", coordinator_port=port,
+                       cycle_time_ms=2.0)
+    try:
+        for i in range(12):
+            faults.step(i, rank=rank)
+            h = eng.enqueue(f"g{i}", np.ones(4, np.float32), OP_ALLREDUCE)
+            eng.synchronize(h, timeout_s=120.0)
+        print(f"RANK{rank} DONE attempt="
+              f"{os.environ.get('HVD_TPU_RESTART_ATTEMPT')}", flush=True)
+        eng.shutdown()
+    except CollectiveError:
+        print(f"RANK{rank} REPORT={eng.failure_report()!r}", flush=True)
+        time.sleep(30)  # engine grace exits 75
+""")
+
+
+def test_launcher_restarts_after_heartbeat_detected_kill():
+    env = {**os.environ, "PYTHONPATH": REPO, **FAST_HB,
+           "HVD_TPU_RESTART_BACKOFF": "0.1",
+           "HVD_TPU_FAULT_KILL_RANK": "1",
+           "HVD_TPU_FAULT_KILL_STEP": "6"}
+    res = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+         "--platform", "", "--max-restarts", "2", "--",
+         sys.executable, "-c", LAUNCHED],
+        cwd=REPO, capture_output=True, text=True, timeout=scaled(120),
+        env=env)
+    assert res.returncode == 0, res.stdout[-3000:] + res.stderr[-2000:]
+    assert "killing rank 1 at step 6" in res.stdout, res.stdout[-3000:]
+    # The survivor detected the death structurally (not the stall window).
+    assert "REPORT=" in res.stdout and "'failed_rank': 1" in res.stdout, \
+        res.stdout[-3000:]
+    assert "restarting (attempt 1" in res.stderr, res.stderr[-2000:]
+    assert "RANK0 DONE attempt=1" in res.stdout, res.stdout[-3000:]
+    assert "RANK1 DONE attempt=1" in res.stdout, res.stdout[-3000:]
+
+
+# TSAN leg (make check): the monitor thread vs cycle thread vs client
+# threads, across a real peer death AND a clean concurrent shutdown.
+TSAN_WORKER = textwrap.dedent("""
+    import sys, threading, time
+    import numpy as np
+    from horovod_tpu.core.engine import NativeEngine, OP_ALLREDUCE, \\
+        CollectiveError
+    from horovod_tpu.core.executors import local_executor
+
+    rank, port, n = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    mode = sys.argv[4]
+    eng = NativeEngine(rank, n, executor=local_executor,
+                       coordinator_host="127.0.0.1", coordinator_port=port,
+                       cycle_time_ms=1.0)
+
+    stop = threading.Event()
+
+    def pound(tid):
+        i = 0
+        while not stop.is_set() and i < 200:
+            try:
+                h = eng.enqueue(f"t{tid}.{i}", np.ones(16, np.float32),
+                                OP_ALLREDUCE)
+                eng.synchronize(h, timeout_s=60.0)
+            except (CollectiveError, RuntimeError, TimeoutError):
+                stop.set()
+                return
+            i += 1
+
+    threads = [threading.Thread(target=pound, args=(t,)) for t in range(2)]
+    for t in threads: t.start()
+    if mode == "die" and rank == 1:
+        time.sleep(0.5)
+        import os, signal
+        os.kill(os.getpid(), signal.SIGKILL)
+    if mode == "clean":
+        time.sleep(0.8)
+        stop.set()
+        for t in threads: t.join()
+        eng.shutdown()   # clean shutdown races the live monitor thread
+        print(f"RANK{rank} OK", flush=True)
+    else:
+        for t in threads: t.join()
+        print(f"RANK{rank} REPORT={eng.failure_report()!r}", flush=True)
+        time.sleep(60)
+""")
+
+
+@pytest.mark.tsan
+@pytest.mark.slow
+@pytest.mark.parametrize("mode", ["clean", "die"])
+def test_monitor_thread_under_tsan(mode):
+    """The heartbeat monitor under ThreadSanitizer: concurrent client
+    enqueues + cycle thread + monitor thread through (a) a clean shutdown
+    with the monitor live and (b) a real SIGKILL peer death with the
+    coordinated abort.  No data-race report may implicate libhvdcore."""
+    core = os.path.join(REPO, "horovod_tpu", "core")
+    rc = subprocess.run(["make", "-C", core, "tsan", "-j4"],
+                        capture_output=True)
+    if rc.returncode != 0 and not os.path.exists(
+            os.path.join(core, "libhvdcore_tsan.so")):
+        pytest.skip("tsan build unavailable")
+    runtime = tsan_runtime()
+    if runtime is None:
+        pytest.skip("libtsan runtime not installed")
+    port = _free_port()
+    env = {**os.environ, "PYTHONPATH": REPO, **FAST_HB,
+           # TSAN is ~10x slower: give silence-detection real slack so the
+           # only deaths are the injected ones, and a wide abort grace so
+           # the slowed Python side still gets its REPORT line out.
+           "HVD_TPU_HEARTBEAT_TIMEOUT_MS": str(int(scaled(8000))),
+           "HVD_TPU_ABORT_GRACE_MS": "5000",
+           "HVD_CORE_LIB": "libhvdcore_tsan.so",
+           "LD_PRELOAD": runtime,
+           "TSAN_OPTIONS": "report_bugs=1 halt_on_error=0 exitcode=0"}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", TSAN_WORKER, str(r), str(port), "2",
+             mode],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=REPO)
+        for r in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            outs.append(p.communicate(timeout=scaled(240)))
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+    if mode == "clean":
+        for r in range(2):
+            assert f"RANK{r} OK" in outs[r][0], outs[r][1][-3000:]
+    else:
+        assert procs[0].returncode == 75, (procs[0].returncode,
+                                           outs[0][1][-3000:])
+        assert "'failed_rank': 1" in outs[0][0], outs[0][0][-2000:]
+    for r, (out, err) in enumerate(outs):
+        # Uninstrumented CPython/numpy can produce false positives; only a
+        # report whose stack touches our library is a real finding.
+        for chunk in err.split("WARNING: ThreadSanitizer")[1:]:
+            assert "hvdcore" not in chunk.split("=" * 18)[0], (
+                f"tsan race in libhvdcore on rank {r}:\n{chunk[:4000]}")

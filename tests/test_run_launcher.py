@@ -102,6 +102,42 @@ def _supervised(np_, script, *flags, timeout):
         cwd=REPO, capture_output=True, text=True, timeout=timeout, env=env)
 
 
+# Rank 1 is the originator (exit 7); every other rank lingers and is
+# SIGTERM'd by the launcher's job-abort (rc -15 → 143).  Secondary exits
+# must never mask the originator in supervision accounting.
+ORIGINATOR_SCRIPT = textwrap.dedent("""
+    import os, sys, time
+    rank = int(os.environ["JAX_PROCESS_ID"])
+    attempt = int(os.environ.get("HVD_TPU_RESTART_ATTEMPT", "0"))
+    if rank == 1 and attempt == 0:
+        time.sleep(0.3)   # let the peers reach their sleep first
+        sys.exit(7)
+    if attempt > 0:
+        sys.exit(0)       # relaunched job runs clean
+    time.sleep(120)       # terminated by the launcher, not run out
+""")
+
+
+def test_secondary_sigterm_exits_never_mask_originator():
+    """Supervision/restart accounting keys off the ORIGINATING abnormal
+    exit: ranks the launcher SIGTERMs afterwards (rc -15 → 143) ride along
+    in the same teardown and must not become the recorded job exit code —
+    neither in the restart log line nor in the budget-exhausted final
+    code."""
+    res = _supervised(3, ORIGINATOR_SCRIPT, "--max-restarts", "1",
+                      timeout=scaled(60))
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "rank 1 exited with code 7" in res.stderr, res.stderr
+    # The restart accounting recorded the originator's 7, not a
+    # secondary's 143.
+    assert "job failed with exit code 7" in res.stderr, res.stderr
+    assert "exit code 143" not in res.stderr, res.stderr
+
+    # Without restart budget the job's own exit code is the originator's.
+    res = _supervised(3, ORIGINATOR_SCRIPT, timeout=scaled(60))
+    assert res.returncode == 7, res.stdout + res.stderr
+
+
 def test_restart_recovers_flaky_job():
     res = _supervised(2, FLAKY_SCRIPT, "--max-restarts", "2",
                       timeout=scaled(60))
