@@ -39,6 +39,21 @@ Two phases, one JSON metric line each:
 
    ``vs_baseline`` is the MTTR improvement over the pre-heartbeat story,
    where a dead peer sat invisible until the 60 s stall detector fired.
+
+4. **Elastic recovery** (``bench.py --fault --elastic``) — three-process
+   engine job under ``HVD_TPU_ELASTIC=1``; rank 2 is SIGKILLed at steady
+   state and the survivors' in-place shrink (RECONFIG broadcast + same-
+   process engine re-form, docs/fault_tolerance.md "In-place recovery")
+   is timed kill → survivors training again, next to the full
+   restart-from-checkpoint path on the same scenario::
+
+       {"metric": "elastic_recovery_ms", "value": N, "unit": "ms",
+        "vs_baseline": <full_restart_recovery_ms / value>,
+        "full_restart_recovery_ms": M}
+
+   ``vs_baseline`` is the speedup of shrinking in place over tearing every
+   process down and relaunching from the newest checkpoint (the PR-1
+   recovery story); the acceptance bar is >= 5x.
 """
 
 from __future__ import annotations
@@ -177,9 +192,149 @@ def fault_bench() -> None:
     }))
 
 
+_ELASTIC_WORKER = textwrap.dedent("""
+    import sys, time
+    import numpy as np
+    from horovod_tpu.core.engine import NativeEngine, OP_ALLREDUCE, \\
+        MembershipChanged, CollectiveError
+    from horovod_tpu.core import engine as em
+    from horovod_tpu.core.executors import local_executor
+    from horovod_tpu import elastic
+
+    rank, port, n = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    eng = NativeEngine(rank, n, executor=local_executor,
+                       coordinator_host="127.0.0.1", coordinator_port=port,
+                       cycle_time_ms=2.0)
+    elastic.attach(eng)
+    i, done, resumed = 0, 0, False
+    while done < 5000:
+        try:
+            h = eng.enqueue(f"b{i}", np.ones(1024, np.float32),
+                            OP_ALLREDUCE)
+            eng.synchronize(h, timeout_s=120.0)
+            done += 1
+            i += 1
+            if done == 20:
+                print("STEADY", flush=True)
+            if resumed:
+                # First collective COMPLETED under the shrunken
+                # membership: the survivors are training again.
+                print(f"RESUMED ts={time.time():.6f}", flush=True)
+                break
+        except MembershipChanged:
+            ev = elastic.reconfigure()
+            eng = em.peek_engine()
+            i = ev.epoch * 100000
+            resumed = True
+        except CollectiveError:
+            time.sleep(10)
+            sys.exit(3)
+""")
+
+
+# Launcher child for the full-restart comparison: same 3-proc kill, but
+# recovery = teardown + relaunch + re-rendezvous (PR-1 supervision).
+_RESTART_WORKER = textwrap.dedent("""
+    import os, sys, time
+    import numpy as np
+    from horovod_tpu.core.engine import NativeEngine, OP_ALLREDUCE, \\
+        CollectiveError
+    from horovod_tpu.core.executors import local_executor
+    from horovod_tpu import faults
+
+    rank = int(os.environ["JAX_PROCESS_ID"])
+    n = int(os.environ["JAX_NUM_PROCESSES"])
+    port = int(os.environ["HVD_TPU_COORDINATOR_PORT"])
+    attempt = int(os.environ.get("HVD_TPU_RESTART_ATTEMPT", "0"))
+    eng = NativeEngine(rank, n, executor=local_executor,
+                       coordinator_host="127.0.0.1", coordinator_port=port,
+                       cycle_time_ms=2.0)
+    try:
+        for i in range(40):
+            if rank == 2 and attempt == 0 and i == 25:
+                print(f"KILLNOW ts={time.time():.6f}", flush=True)
+            faults.step(i, rank=rank)
+            h = eng.enqueue(f"g{i}", np.ones(1024, np.float32),
+                            OP_ALLREDUCE)
+            eng.synchronize(h, timeout_s=120.0)
+            if i == 0 and attempt > 0:
+                # First collective of the relaunched attempt completed:
+                # the job is training again after the full restart.
+                print(f"TRAINING ts={time.time():.6f}", flush=True)
+        eng.shutdown()
+    except CollectiveError:
+        time.sleep(30)  # the abort grace exits 75; supervisor relaunches
+""")
+
+
+def elastic_bench() -> None:
+    """Kill → survivors-training-again MTTR of in-place elastic recovery,
+    vs the full teardown+relaunch path on the same 3-process scenario."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    base_env = {**os.environ, "PYTHONPATH": here,
+                "HVD_TPU_HEARTBEAT_MS": "50",
+                "HVD_TPU_HEARTBEAT_TIMEOUT_MS": "1000",
+                "HVD_TPU_ABORT_GRACE_MS": "100",
+                "HVD_TPU_CONNECT_TIMEOUT": "60"}
+
+    def port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    # In-place shrink: kill rank 2, read the survivor's RESUMED stamp.
+    p0_port = port()
+    env = {**base_env, "HVD_TPU_ELASTIC": "1",
+           "HVD_TPU_RECONFIG_TIMEOUT_MS": "20000"}
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _ELASTIC_WORKER, str(r), str(p0_port), "3"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=here) for r in range(3)]
+    for line in procs[0].stdout:
+        if "STEADY" in line:
+            break
+    procs[2].send_signal(signal.SIGKILL)
+    t_kill = time.time()
+    out0, _ = procs[0].communicate(timeout=120)
+    for p in procs[1:]:
+        p.kill()
+        p.wait()
+    resumed_ts = float(out0.split("RESUMED ts=", 1)[1].split()[0])
+    elastic_ms = (resumed_ts - t_kill) * 1e3
+
+    # Full restart on the same scenario: launcher supervision, injected
+    # SIGKILL of rank 2, recovery ends at the relaunched attempt's first
+    # completed collective.
+    env = {**base_env, "HVD_TPU_RESTART_BACKOFF": "0.1",
+           "HVD_TPU_FAULT_KILL_RANK": "2", "HVD_TPU_FAULT_KILL_STEP": "25"}
+    env.pop("HVD_TPU_ELASTIC", None)
+    res = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "3",
+         "--platform", "", "--max-restarts", "2", "--",
+         sys.executable, "-c", _RESTART_WORKER],
+        cwd=here, capture_output=True, text=True, timeout=300, env=env)
+    kill_ts = float(res.stdout.split("KILLNOW ts=", 1)[1].split()[0])
+    train_ts = min(float(c.split()[0])
+                   for c in res.stdout.split("TRAINING ts=")[1:])
+    restart_ms = (train_ts - kill_ts) * 1e3
+
+    print(json.dumps({
+        "metric": "elastic_recovery_ms",
+        "value": round(elastic_ms, 1),
+        "unit": "ms",
+        "vs_baseline": round(restart_ms / max(elastic_ms, 1e-9), 1),
+        "full_restart_recovery_ms": round(restart_ms, 1),
+    }))
+
+
 def main() -> None:
     if "--fault" in sys.argv:
-        fault_bench()
+        if "--elastic" in sys.argv:
+            elastic_bench()
+        else:
+            fault_bench()
         return
     if os.environ.get("BENCH_SKIP_EAGER") != "1":
         eager_microbench()

@@ -43,7 +43,8 @@ for _mod, _names in {
         "stall_report", "subset_active",
     ),
     "horovod_tpu.analysis.schedule": ("divergence_report",),
-    "horovod_tpu.core.engine": ("CollectiveError",),
+    "horovod_tpu.core.engine": ("CollectiveError", "MembershipChanged"),
+    "horovod_tpu.elastic": ("on_reconfigure", "resize_event"),
     "horovod_tpu.mesh": (
         "DATA_AXIS", "data_sharding", "data_spec", "global_mesh",
         "replicated_sharding",
@@ -72,9 +73,9 @@ del _mod, _names, _n
 _MODULE_ATTRS = {"profiling": "horovod_tpu.utils.profiling"}
 
 _SUBMODULES = frozenset({
-    "basics", "callbacks", "checkpoint", "core", "data", "faults", "flax",
-    "keras", "mesh", "models", "ops", "parallel", "run", "tensorflow",
-    "torch", "training", "utils",
+    "basics", "callbacks", "checkpoint", "core", "data", "elastic",
+    "faults", "flax", "keras", "mesh", "models", "ops", "parallel", "run",
+    "tensorflow", "torch", "training", "utils",
 })
 
 # NOTE: __all__ deliberately excludes the lazy submodules — a star-import

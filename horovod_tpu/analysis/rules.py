@@ -449,10 +449,119 @@ class UnknownAxisName(Rule):
         return out
 
 
+class StaleTopologyConstant(Rule):
+    """``hvd.size()``/``hvd.rank()`` cached where elastic resize can't
+    reach it: a module- or class-level constant, or a default parameter
+    value (frozen at ``def`` time — the classic closure-constant idiom).
+
+    Under ``HVD_TPU_ELASTIC=1`` (docs/fault_tolerance.md "In-place
+    recovery") a membership reconfiguration reassigns ranks and changes
+    the world size *inside a live process*: every such cached value is
+    silently stale afterwards — wrong data shards, wrong LR scale, wrong
+    rank-0 gating.  Exempt: names that are refreshed inside an
+    ``on_reconfigure`` callback, which is exactly where such caches
+    belong.
+    """
+
+    code = "HVD106"
+    name = "stale-topology-constant"
+    hint = ("call hvd.size()/hvd.rank() at use time, or refresh the cached "
+            "value inside an @hvd.on_reconfigure callback (elastic resize "
+            "changes both in a live process)")
+
+    _TOPO = frozenset({"rank", "size", "local_rank", "local_size",
+                       "cross_rank", "cross_size", "num_chips"})
+    _ROOTS = frozenset({"horovod_tpu", "hvd"})
+
+    def _topo_call(self, ctx: Context, node: ast.AST) -> str | None:
+        """Dotted path of a zero-arg topology call inside ``node``."""
+        for sub in ast.walk(node):
+            if not (isinstance(sub, ast.Call) and not sub.args
+                    and not sub.keywords):
+                continue
+            path = dotted(sub.func)
+            if path is None or path.split(".")[-1] not in self._TOPO:
+                continue
+            # Bare ``size()`` only counts when imported from horovod_tpu;
+            # ``q.size()`` on some queue object must not trip the rule.
+            if "." not in path and ctx.resolve(path) == path:
+                continue
+            if ctx.resolve(path).split(".")[0] in self._ROOTS:
+                return path
+        return None
+
+    @staticmethod
+    def _is_on_reconfigure(deco: ast.expr) -> bool:
+        target = deco.func if isinstance(deco, ast.Call) else deco
+        path = dotted(target)
+        return path is not None and path.split(".")[-1] == "on_reconfigure"
+
+    def run(self, ctx: Context) -> list[Finding]:
+        out: list[Finding] = []
+        # Names some on_reconfigure callback refreshes are exempt — the
+        # cache is elastic-aware by construction.
+        refreshed: set[str] = set()
+        for node in ast.walk(ctx.module):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not any(self._is_on_reconfigure(d)
+                       for d in node.decorator_list):
+                continue
+            for sub in ast.walk(node):
+                if isinstance(sub, (ast.Assign, ast.AugAssign,
+                                    ast.AnnAssign)):
+                    targets = sub.targets if isinstance(sub, ast.Assign) \
+                        else [sub.target]
+                    for t in targets:
+                        for n in ast.walk(t):
+                            if isinstance(n, ast.Name):
+                                refreshed.add(n.id)
+
+        def scan_body(body: list[ast.stmt]) -> None:
+            for stmt in body:
+                if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                    value = getattr(stmt, "value", None)
+                    if value is None:
+                        continue
+                    path = self._topo_call(ctx, value)
+                    if path is None:
+                        continue
+                    targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                        else [stmt.target]
+                    names = {n.id for t in targets for n in ast.walk(t)
+                             if isinstance(n, ast.Name)}
+                    if names and names <= refreshed:
+                        continue
+                    out.append(self.finding(stmt, (
+                        f"'{path}()' cached into a module/class-level "
+                        f"constant: an elastic membership resize "
+                        f"(HVD_TPU_ELASTIC) changes rank/size in a live "
+                        f"process, leaving this value silently stale")))
+                elif isinstance(stmt, ast.ClassDef):
+                    scan_body(stmt.body)
+
+        scan_body(ctx.module.body)
+        for node in ast.walk(ctx.module):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            defaults = list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None]
+            for d in defaults:
+                path = self._topo_call(ctx, d)
+                if path is not None:
+                    out.append(self.finding(d, (
+                        f"'{path}()' used as a default parameter value of "
+                        f"'{node.name}': defaults are evaluated once at "
+                        f"def time and go stale when an elastic resize "
+                        f"(HVD_TPU_ELASTIC) changes rank/size")))
+        return out
+
+
 RULES: list[Rule] = [
     RankDivergentCollective(),
     UnnamedCollectiveInLoop(),
     NondeterministicName(),
     ImpureJitStep(),
     UnknownAxisName(),
+    StaleTopologyConstant(),
 ]

@@ -255,6 +255,22 @@ def is_initialized() -> bool:
     return _topology is not None
 
 
+def _apply_resize(new_rank: int, new_size: int) -> None:
+    """Elastic membership update (elastic.reconfigure): republish
+    ``rank()``/``size()`` for the surviving membership so data sharding,
+    rank-0 gating, and LR scaling see the new world.  A no-op before
+    ``init()`` (engine-only workers track membership through the engine
+    itself).  The device topology (num_chips, mesh) is left as initialized
+    — the compiled SPMD plane cannot re-form in-process and elastic mode
+    documents that scope (docs/fault_tolerance.md)."""
+    global _topology
+    with _lock:
+        if _topology is None:
+            return
+        _topology = dataclasses.replace(_topology, rank=new_rank,
+                                        size=new_size)
+
+
 def _topo() -> Topology:
     if _topology is None:
         raise NotInitializedError()

@@ -158,7 +158,7 @@ def wait_pending() -> None:
 
 
 def save(path: str | os.PathLike, state: Any, *, force: bool = True,
-         background: bool = False) -> None:
+         background: bool = False, rank: int | None = None) -> None:
     """Write ``state`` (any pytree) at ``path``; no-op off rank 0.
 
     ``background=True`` returns as soon as the state is snapshotted and
@@ -169,8 +169,13 @@ def save(path: str | os.PathLike, state: Any, *, force: bool = True,
     the atomic-rename contract is unchanged.  The first background save
     pays orbax's one-time worker setup (~seconds) synchronously; steady-
     state kick cost is tens of milliseconds.
+
+    ``rank`` overrides the rank-0 gate for engine-only jobs that never
+    call ``hvd.init()`` (the elastic eager path, docs/fault_tolerance.md):
+    without it, a launcher-spawned worker raises NotInitializedError here
+    by design.
     """
-    if _rank() != 0:
+    if (_rank() if rank is None else rank) != 0:
         return
     path = os.path.abspath(os.fspath(path))
     # Rank-0-only writes (the reference contract) use a LONE-process orbax
@@ -484,19 +489,36 @@ class CheckpointManager:
     complete step — tests/test_elastic.py).
     """
 
-    def __init__(self, directory: str | os.PathLike, *, max_to_keep: int = 2):
+    def __init__(self, directory: str | os.PathLike, *, max_to_keep: int = 2,
+                 rank: int | None = None, size: int | None = None):
         if max_to_keep < 1:
             raise ValueError("max_to_keep must be >= 1")
         self.directory = os.path.abspath(os.fspath(directory))
         self.max_to_keep = max_to_keep
+        # Explicit rank/size override the hvd.init() topology — for
+        # engine-only elastic workers (docs/fault_tolerance.md) that track
+        # membership through the engine rather than jax.distributed.
+        # ``size=1`` additionally opts restore_latest out of the
+        # coordinated broadcast: every rank reads the shared directory
+        # directly (same-host launcher jobs).
+        self._rank_override = rank
+        self._size_override = size
         self._pending: list[tuple[int, dict | None]] = []
-        if _rank() == 0:
+        if self._my_rank() == 0:
             os.makedirs(self.directory, exist_ok=True)
         # Commit any in-flight background manifest before interpreter
         # teardown (same _register_atexit reasoning as wait_pending above).
         register = getattr(threading, "_register_atexit", atexit.register)
         register(self.drain)
         atexit.register(self.drain)
+
+    def _my_rank(self) -> int:
+        return self._rank_override if self._rank_override is not None \
+            else _rank()
+
+    def _my_size(self) -> int:
+        return self._size_override if self._size_override is not None \
+            else _size()
 
     # -- writing ------------------------------------------------------------
 
@@ -510,7 +532,7 @@ class CheckpointManager:
         until it is real.  ``metadata`` is the resume record (step is
         always included; add rng key, data offsets, ... for bit-exact
         resume)."""
-        if _rank() != 0:
+        if self._my_rank() != 0:
             return
         self._flush_pending()
         path = manifest.step_dir(self.directory, step)
@@ -520,7 +542,8 @@ class CheckpointManager:
             # with it, so readers never see a half-updated mix.
             shutil.rmtree(path)
         os.makedirs(path, exist_ok=True)
-        save(os.path.join(path, "state"), state, background=background)
+        save(os.path.join(path, "state"), state, background=background,
+             rank=0)
         if background:
             self._pending.append((step, metadata))
         else:
@@ -533,7 +556,7 @@ class CheckpointManager:
         This is the preemption drain: the SIGTERM path calls it (via
         ``save``'s flush or directly) so the job exits with a complete
         last checkpoint, never a torn one."""
-        if _rank() != 0:
+        if self._my_rank() != 0:
             return
         self._flush_pending()
 
@@ -587,7 +610,7 @@ class CheckpointManager:
         real read, so a payload that fails to deserialize is skipped with
         a warning), broadcasts the verdict, and every rank restores the
         agreed step so the job resumes in lockstep."""
-        coordinated = broadcast and _size() > 1
+        coordinated = broadcast and self._my_size() > 1
         if not coordinated:
             picked = self._pick_restorable(template)
             if picked is None:
@@ -595,7 +618,7 @@ class CheckpointManager:
             step, md = picked
             state = restore(self._state_path(step), template, broadcast=False)
             return ElasticCheckpoint(step, state, md)
-        if _rank() == 0:
+        if self._my_rank() == 0:
             self.drain()
             header = self._pick_restorable(template)
         else:

@@ -68,6 +68,16 @@ class CollectiveError(RuntimeError):
     MPIResponse::ERROR → FailedPreconditionError, operations.cc:494-499)."""
 
 
+class MembershipChanged(CollectiveError):
+    """An elastic membership reconfiguration aborted the collective
+    (docs/fault_tolerance.md "In-place recovery"): a rank left (shrink) or
+    a relaunched rank rejoined (grow).  The engine is stopped and
+    :func:`resize_event` carries the new membership; call
+    ``horovod_tpu.elastic.reconfigure()`` to re-form the engine in this
+    same process, then reissue work — ``training.elastic_loop`` does both
+    automatically."""
+
+
 def _build_library() -> None:
     # Build the target matching the requested library (HVD_CORE_LIB may
     # select the tsan build).
@@ -97,7 +107,7 @@ def _load_library() -> ctypes.CDLL:
         ctypes.c_int, ctypes.c_int, ctypes.c_double, ctypes.c_longlong,
         ctypes.c_longlong,
         ctypes.c_double, ctypes.c_int, ctypes.c_double, ctypes.c_int,
-        ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_longlong,
         ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
     lib.hvd_start.restype = ctypes.c_int
     lib.hvd_start.argtypes = [ctypes.c_void_p,
@@ -133,6 +143,13 @@ def _load_library() -> ctypes.CDLL:
     lib.hvd_failure_report.restype = ctypes.c_int
     lib.hvd_failure_report.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                        ctypes.c_int]
+    lib.hvd_resize_event.restype = ctypes.c_int
+    lib.hvd_resize_event.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_int]
+    lib.hvd_resize_ack.restype = None
+    lib.hvd_resize_ack.argtypes = [ctypes.c_void_p]
+    lib.hvd_detach_listener.restype = None
+    lib.hvd_detach_listener.argtypes = [ctypes.c_void_p]
     lib.hvd_poll.restype = ctypes.c_int
     lib.hvd_poll.argtypes = [ctypes.c_void_p, ctypes.c_longlong]
     lib.hvd_wait.restype = ctypes.c_int
@@ -222,9 +239,20 @@ class NativeEngine:
                  coordinator_host: str | None = None,
                  coordinator_port: int = 0,
                  cycle_time_ms: float | None = None,
-                 cache_capacity: int | None = None):
+                 cache_capacity: int | None = None,
+                 epoch: int = 0):
         self.rank = rank
         self.size = size
+        self.epoch = epoch
+        # Remembered so an elastic reconfiguration (elastic.py) can re-form
+        # the engine in this same process with the same wiring choices —
+        # executor is kept UN-resolved so the local/multihost default is
+        # re-derived for the new size.
+        self._ctor = dict(executor=executor,
+                          coordinator_host=coordinator_host,
+                          coordinator_port=coordinator_port,
+                          cycle_time_ms=cycle_time_ms,
+                          cache_capacity=cache_capacity)
         self._lib = lib()
         self._store: dict[str, np.ndarray] = {}
         self._results: dict[int, np.ndarray] = {}
@@ -254,6 +282,7 @@ class NativeEngine:
             env.stall_abort_exit_code(),
             1 if self._verify_enabled else 0,
             env.verify_interval_ticks(),
+            epoch,
             tl.encode() if self._timeline_enabled else None,
             (coordinator_host or "127.0.0.1").encode(),
             coordinator_port)
@@ -302,6 +331,13 @@ class NativeEngine:
         if h < 0:
             with self._store_lock:
                 self._store.pop(name, None)
+            if self.resize_event() is not None:
+                # The engine stopped because the membership changed, not
+                # because the job is over: surface the elastic signal so
+                # elastic_loop/callers reconfigure and reissue.
+                raise MembershipChanged(err.value.decode() or
+                                        "membership changed; reconfigure "
+                                        "and reissue")
             raise CollectiveError(err.value.decode())
         with self._store_lock:
             self._handle_names[int(h)] = (name, arr)
@@ -439,6 +475,64 @@ class NativeEngine:
                                   if last_heard_us >= 0 else None),
                 "last_collective": last_collective}
 
+    def resize_event(self) -> dict | None:
+        """Structured elastic resize event (docs/fault_tolerance.md
+        "In-place recovery"): ``None`` while the membership is stable; after
+        a reconfiguration verdict stopped this engine, a dict::
+
+            {"epoch": 1, "old_rank": 2, "new_rank": 1, "old_size": 3,
+             "new_size": 2, "failed_rank": 1, "cause": "connection_reset"}
+
+        ``failed_rank`` is -1 for a grow (a relaunched rank rejoined).  The
+        engine is stopped at this point — ``elastic.reconfigure()`` acks
+        the event and re-forms the engine under the new membership."""
+        buf = ctypes.create_string_buffer(1 << 12)
+        n = self._lib.hvd_resize_event(self._ptr, buf, len(buf))
+        if n < -1:
+            buf = ctypes.create_string_buffer(-n + 16)
+            n = self._lib.hvd_resize_event(self._ptr, buf, len(buf))
+        if n <= 0:
+            return None
+        raw = buf.raw[:n]
+        off = 0
+
+        def i32():
+            nonlocal off
+            v = struct.unpack_from("<i", raw, off)[0]
+            off += 4
+            return v
+
+        def i64():
+            nonlocal off
+            v = struct.unpack_from("<q", raw, off)[0]
+            off += 8
+            return v
+
+        if i32() == 0:
+            return None
+        epoch = i64()
+        old_rank, new_rank, old_size, new_size, failed_rank = (
+            i32(), i32(), i32(), i32(), i32())
+        ln = i32()
+        cause = raw[off:off + ln].decode()
+        return {"epoch": epoch, "old_rank": old_rank, "new_rank": new_rank,
+                "old_size": old_size, "new_size": new_size,
+                "failed_rank": failed_rank, "cause": cause}
+
+    def resize_ack(self) -> None:
+        """Acknowledge the resize event: stands the native engine's bounded
+        reconfig-timeout fallback exit down so this process can re-form the
+        engine in place (called by ``elastic.reconfigure``)."""
+        self._lib.hvd_resize_ack(self._ptr)
+
+    def detach_listener(self) -> None:
+        """Coordinator, reconfiguration hand-off: release the control-plane
+        listen port for the re-formed membership while this stopped
+        engine's peer sockets stay open — survivors that have not yet read
+        the RECONFIG broadcast must not be RST (``elastic.reconfigure``
+        destroys this engine only after the new rendezvous completes)."""
+        self._lib.hvd_detach_listener(self._ptr)
+
     def stall_report(self) -> list[tuple[str, list[int]]]:
         """Structured stall view: [(tensor_name, [missing ranks]), ...].
 
@@ -493,8 +587,12 @@ class NativeEngine:
                 if self._store.get(name) is arr:
                     self._store.pop(name, None)
         if rc == STATUS_PRECONDITION:
+            if self.resize_event() is not None:
+                raise MembershipChanged(err.value.decode())
             raise CollectiveError(err.value.decode())
         if rc != STATUS_OK:
+            if self.resize_event() is not None:
+                raise MembershipChanged(err.value.decode())
             raise RuntimeError(
                 f"collective failed (status {rc}): {err.value.decode()}")
         return result
@@ -626,6 +724,26 @@ def failure_report() -> dict | None:
     with _engine_lock:
         eng = _engine
     return eng.failure_report() if eng is not None else None
+
+
+def resize_event() -> dict | None:
+    """Module-level elastic resize event; ``None`` when the engine was
+    never started or the membership is stable (the compiled SPMD path has
+    no elastic story — XLA lockstep)."""
+    with _engine_lock:
+        eng = _engine
+    return eng.resize_event() if eng is not None else None
+
+
+def replace_engine(old: NativeEngine | None,
+                   new: NativeEngine | None) -> None:
+    """Swap the module singleton during an elastic reconfiguration
+    (elastic.py): only replaces when ``old`` IS the current singleton, so
+    explicitly-constructed test engines never hijack an unrelated one."""
+    global _engine
+    with _engine_lock:
+        if _engine is old or _engine is None:
+            _engine = new
 
 
 def shutdown_engine() -> None:

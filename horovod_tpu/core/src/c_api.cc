@@ -38,8 +38,8 @@ struct Writer {
   }
 };
 
-// Heartbeat knobs ride the environment (like HVD_TPU_CONNECT_TIMEOUT in
-// controller.cc) rather than widening the create ABI: they are pure
+// Heartbeat/elastic knobs ride the environment (like HVD_TPU_CONNECT_TIMEOUT
+// in controller.cc) rather than widening the create ABI: they are pure
 // control-plane tuning, documented in utils/env.py.
 double EnvMs(const char* horovod_name, const char* hvd_tpu_name,
              double fallback) {
@@ -47,6 +47,14 @@ double EnvMs(const char* horovod_name, const char* hvd_tpu_name,
   if (v == nullptr || *v == '\0') v = std::getenv(hvd_tpu_name);
   if (v == nullptr || *v == '\0') return fallback;
   return std::atof(v);
+}
+
+bool EnvFlag(const char* horovod_name, const char* hvd_tpu_name) {
+  const char* v = std::getenv(horovod_name);
+  if (v == nullptr || *v == '\0') v = std::getenv(hvd_tpu_name);
+  if (v == nullptr || *v == '\0') return false;
+  return std::strcmp(v, "0") != 0 && std::strcmp(v, "false") != 0 &&
+         std::strcmp(v, "False") != 0;
 }
 
 }  // namespace
@@ -58,11 +66,12 @@ void* hvd_create(int rank, int size, double cycle_ms,
                  double stall_seconds, int stall_check,
                  double stall_abort_seconds, int stall_abort_exit_code,
                  int verify_schedule, int verify_interval_ticks,
-                 const char* timeline_path, const char* coord_host,
-                 int coord_port) {
+                 long long epoch, const char* timeline_path,
+                 const char* coord_host, int coord_port) {
   EngineOptions opts;
   opts.rank = rank;
   opts.size = size;
+  opts.epoch = epoch;
   opts.cycle_time_ms = cycle_ms;
   opts.fusion_threshold_bytes = fusion_threshold;
   opts.cache_capacity = cache_capacity >= 0 ? cache_capacity : 0;
@@ -86,6 +95,15 @@ void* hvd_create(int rank, int size, double cycle_ms,
             opts.heartbeat_timeout_ms);
   opts.abort_grace_ms = EnvMs("HOROVOD_ABORT_GRACE_MS",
                               "HVD_TPU_ABORT_GRACE_MS", opts.abort_grace_ms);
+  // In-place elastic recovery (docs/fault_tolerance.md "In-place
+  // recovery"): mode switch, shrink floor, and the bounded reconfiguration
+  // hand-off — all pure control-plane tuning, documented in utils/env.py.
+  opts.elastic = EnvFlag("HOROVOD_ELASTIC", "HVD_TPU_ELASTIC");
+  opts.min_size = static_cast<int>(
+      EnvMs("HOROVOD_MIN_SIZE", "HVD_TPU_MIN_SIZE", 1));
+  opts.reconfig_timeout_ms =
+      EnvMs("HOROVOD_RECONFIG_TIMEOUT_MS", "HVD_TPU_RECONFIG_TIMEOUT_MS",
+            opts.reconfig_timeout_ms);
   return new Engine(std::move(opts));
 }
 
@@ -242,6 +260,45 @@ int hvd_failure_report(void* e, char* buf, int buflen) {
   }
   std::memcpy(buf, w.buf.data(), w.buf.size());
   return static_cast<int>(w.buf.size());
+}
+
+// Serialized elastic resize event (docs/fault_tolerance.md "In-place
+// recovery"): i32 present (0 = none), then {i64 epoch, i32 old_rank,
+// i32 new_rank, i32 old_size, i32 new_size, i32 failed_rank, str cause}.
+// Returns bytes written, or -needed-1 when buflen is too small
+// (hvd_next_batch's grow-and-retry convention).
+int hvd_resize_event(void* e, char* buf, int buflen) {
+  auto v = static_cast<Engine*>(e)->ResizeEvent();
+  Writer w;
+  if (!v.present) {
+    w.i32(0);
+  } else {
+    w.i32(1);
+    w.i64(v.epoch);
+    w.i32(v.old_rank);
+    w.i32(v.new_rank);
+    w.i32(v.old_size);
+    w.i32(v.new_size);
+    w.i32(v.failed_rank);
+    w.str(v.cause);
+  }
+  if (static_cast<int>(w.buf.size()) > buflen) {
+    return -static_cast<int>(w.buf.size()) - 1;
+  }
+  std::memcpy(buf, w.buf.data(), w.buf.size());
+  return static_cast<int>(w.buf.size());
+}
+
+// Python acknowledges the resize: the stopped engine may be destroyed and
+// re-formed under the new membership; the reconfig-timeout fallback exit
+// stands down.
+void hvd_resize_ack(void* e) { static_cast<Engine*>(e)->AckResize(); }
+
+// Coordinator, reconfiguration hand-off: free the listen port for the new
+// membership while the old engine's peer sockets stay open (stragglers
+// must be able to read the RECONFIG broadcast without being RST).
+void hvd_detach_listener(void* e) {
+  static_cast<Engine*>(e)->DetachListener();
 }
 
 int hvd_poll(void* e, long long handle) {

@@ -100,9 +100,12 @@ uint8_t WireVersionFromEnv() {
   return kWireVersion;
 }
 
-// HVD_TPU_FAULT_WIRE_* = "<rank>[:<frame>]", gated on the restart-attempt
-// counter exactly like faults.py's process-level injectors.
-TcpControlPlane::WireFaultSpec ParseWireFaultEnv() {
+// HVD_TPU_FAULT_WIRE_* = "<rank>[:<frame>][@<epoch>]", gated on the
+// restart-attempt counter exactly like faults.py's process-level injectors
+// AND on the membership epoch (so an elastic shrink past the fault runs
+// clean at the new epoch instead of re-tripping forever; faults.py parses
+// the identical grammar).
+TcpControlPlane::WireFaultSpec ParseWireFaultEnv(int64_t plane_epoch) {
   using Spec = TcpControlPlane::WireFaultSpec;
   Spec spec;
   const char* attempt = ::getenv("HVD_TPU_RESTART_ATTEMPT");
@@ -126,6 +129,9 @@ TcpControlPlane::WireFaultSpec ParseWireFaultEnv() {
     spec.rank = ::atoi(v);
     const char* colon = std::strchr(v, ':');
     spec.frame = colon != nullptr ? ::atoll(colon + 1) : 0;
+    const char* at = std::strchr(v, '@');
+    spec.epoch = at != nullptr ? ::atoll(at + 1) : 0;
+    if (spec.epoch != plane_epoch) spec.mode = Spec::Mode::NONE;
     return spec;
   }
   return spec;
@@ -174,13 +180,14 @@ struct Backoff {
 // ---------------------------------------------------------------------------
 
 std::unique_ptr<TcpControlPlane> TcpControlPlane::MakeCoordinator(
-    int port, int size, std::string* err) {
+    int port, int size, int64_t epoch, std::string* err) {
   std::unique_ptr<TcpControlPlane> cp(new TcpControlPlane());
   cp->coordinator_ = true;
   cp->rank_ = 0;
   cp->size_ = size;
+  cp->epoch_ = static_cast<uint16_t>(epoch & 0xFFFF);
   cp->wire_version_ = WireVersionFromEnv();
-  cp->fault_ = ParseWireFaultEnv();
+  cp->fault_ = ParseWireFaultEnv(epoch);
   cp->listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
   if (cp->listen_fd_ < 0) {
     *err = "socket() failed";
@@ -211,14 +218,15 @@ std::unique_ptr<TcpControlPlane> TcpControlPlane::MakeCoordinator(
   ::fcntl(cp->listen_fd_, F_SETFL, fl | O_NONBLOCK);
   auto deadline = std::chrono::steady_clock::now() +
                   std::chrono::duration<double>(RendezvousBudgetSeconds());
-  for (int i = 0; i < size - 1; ++i) {
+  int admitted = 0;
+  while (admitted < size - 1) {
     pollfd pfd{cp->listen_fd_, POLLIN, 0};
     int fd = -1;
     for (;;) {
       auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
           deadline - std::chrono::steady_clock::now());
       if (left.count() <= 0) {
-        *err = "rendezvous timed out: " + std::to_string(i) + "/" +
+        *err = "rendezvous timed out: " + std::to_string(admitted) + "/" +
                std::to_string(size - 1) +
                " workers connected (HVD_TPU_CONNECT_TIMEOUT to extend)";
         return nullptr;
@@ -266,12 +274,39 @@ std::unique_ptr<TcpControlPlane> TcpControlPlane::MakeCoordinator(
     bool hello_ok = RecvAll(fd, hdr_buf, kFrameHeaderBytes);
     timeval zero{};
     ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &zero, sizeof(zero));
-    if (hello_ok) DecodeFrameHeader(hdr_buf, &hello_hdr);
-    if (!hello_ok || hello_hdr.magic != kFrameMagic) {
+    if (!hello_ok) {
+      // The peer vanished before speaking — typically a MakeWorker retry
+      // abandoning a connection it parked in our backlog while we were
+      // busy admitting someone else.  Not fatal: keep waiting for a
+      // peer that completes the handshake (the budget still bounds us).
+      ::close(fd);
+      continue;
+    }
+    DecodeFrameHeader(hdr_buf, &hello_hdr);
+    if (hello_hdr.magic != kFrameMagic) {
       ::close(fd);  // not yet registered: the destructor can't release it
       *err = "bad hello: connecting peer did not speak the hardened frame "
              "protocol (corrupted stream or mixed-build peer)";
       return nullptr;
+    }
+    if (hello_hdr.type == static_cast<uint8_t>(FrameType::JOIN)) {
+      // A relaunched rank knocking mid-rendezvous (elastic grow): it is
+      // not part of THIS membership's quorum — turn it away politely and
+      // keep waiting; the joiner retries until the running engine's
+      // monitor thread can admit it at the next reconfiguration boundary.
+      ::close(fd);
+      continue;
+    }
+    if (hello_hdr.flags != cp->epoch_) {
+      // Straggler from a pre-reconfiguration membership: its epoch-stamped
+      // HELLO must not consume a rendezvous slot in the new one.
+      std::fprintf(stderr,
+                   "WARNING: horovod_tpu rejected a stale-epoch hello "
+                   "(peer epoch %u, membership epoch %u)\n",
+                   static_cast<unsigned>(hello_hdr.flags),
+                   static_cast<unsigned>(cp->epoch_));
+      ::close(fd);
+      continue;
     }
     if (hello_hdr.version != cp->wire_version_) {
       std::string skew =
@@ -309,6 +344,7 @@ std::unique_ptr<TcpControlPlane> TcpControlPlane::MakeCoordinator(
       *err = "hello ack send failed to rank " + std::to_string(rank);
       return nullptr;
     }
+    ++admitted;
   }
   cp->last_rx_.assign(cp->worker_fds_.size(),
                       std::chrono::steady_clock::now());
@@ -317,12 +353,14 @@ std::unique_ptr<TcpControlPlane> TcpControlPlane::MakeCoordinator(
 }
 
 std::unique_ptr<TcpControlPlane> TcpControlPlane::MakeWorker(
-    const std::string& host, int port, int rank, std::string* err) {
+    const std::string& host, int port, int rank, int64_t epoch,
+    std::string* err) {
   std::unique_ptr<TcpControlPlane> cp(new TcpControlPlane());
   cp->coordinator_ = false;
   cp->rank_ = rank;
+  cp->epoch_ = static_cast<uint16_t>(epoch & 0xFFFF);
   cp->wire_version_ = WireVersionFromEnv();
-  cp->fault_ = ParseWireFaultEnv();
+  cp->fault_ = ParseWireFaultEnv(epoch);
   int one = 1;
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
@@ -332,13 +370,28 @@ std::unique_ptr<TcpControlPlane> TcpControlPlane::MakeWorker(
     return nullptr;
   }
   // The coordinator may come up long after workers (each peer pays the full
-  // interpreter/jax boot cost independently); retry on a fresh socket each
-  // attempt (POSIX: a socket is unusable after a failed connect) until the
-  // shared rendezvous budget runs out.
+  // interpreter/jax boot cost independently), and during an elastic
+  // reconfiguration a worker can race the coordinator's teardown/re-bind
+  // window — connecting to the OLD membership's dying listen socket, whose
+  // backlog is flushed without ever answering.  So the WHOLE handshake
+  // (connect + HELLO + HELLO_ACK, with a short per-attempt ack timeout)
+  // retries on a fresh socket until the shared rendezvous budget runs out;
+  // only a structured rejection (version/epoch skew, bad-rank verdicts) is
+  // fatal immediately.
   auto deadline = std::chrono::steady_clock::now() +
                   std::chrono::duration<double>(RendezvousBudgetSeconds());
   Backoff backoff{0.02, 1.0, static_cast<unsigned>(rank + 1)};
+  std::string soft_err;  // last retryable failure, reported at budget expiry
   for (int attempt = 0;; ++attempt) {
+    double left = std::chrono::duration<double>(
+        deadline - std::chrono::steady_clock::now()).count();
+    if (left <= 0) {
+      *err = "rendezvous with " + host + ":" + std::to_string(port) +
+             " failed (HVD_TPU_CONNECT_TIMEOUT to extend)" +
+             (soft_err.empty() ? "" : ": " + soft_err);
+      return nullptr;
+    }
+    if (attempt > 0) backoff.Sleep(attempt - 1, left);
     cp->sock_ = ::socket(AF_INET, SOCK_STREAM, 0);
     if (cp->sock_ < 0) {
       *err = "socket() failed";
@@ -346,68 +399,78 @@ std::unique_ptr<TcpControlPlane> TcpControlPlane::MakeWorker(
     }
     ::setsockopt(cp->sock_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
     if (::connect(cp->sock_, reinterpret_cast<sockaddr*>(&addr),
-                  sizeof(addr)) == 0) {
-      break;
+                  sizeof(addr)) != 0) {
+      ::close(cp->sock_);
+      cp->sock_ = -1;
+      soft_err = "connect refused/unreachable";
+      continue;
     }
-    ::close(cp->sock_);
-    cp->sock_ = -1;
-    double left = std::chrono::duration<double>(
-        deadline - std::chrono::steady_clock::now()).count();
-    if (left <= 0) {
-      *err = "connect to " + host + ":" + std::to_string(port) +
-             " failed (HVD_TPU_CONNECT_TIMEOUT to extend)";
+    std::string hello(4, '\0');
+    int32_t r32 = rank;
+    std::memcpy(hello.data(), &r32, 4);
+    if (!cp->SendTypedFrame(cp->sock_, FrameType::HELLO, hello, 0)) {
+      ::close(cp->sock_);
+      cp->sock_ = -1;
+      cp->failed_.store(false);  // handshake retry, not a peer failure
+      cp->failure_ = PeerFailureReport{};
+      soft_err = "hello send failed";
+      continue;
+    }
+    // Await the HELLO_ACK: empty payload = admitted; non-empty = the
+    // coordinator's structured rejection (version skew and friends).  The
+    // wait is per-attempt (5 s, clamped to the budget): a connection
+    // parked in a dead listener's backlog must recycle, not consume the
+    // whole budget.
+    long long ack_ms = std::min<long long>(
+        static_cast<long long>(left * 1000), 5000);
+    ack_ms = std::max<long long>(ack_ms, 100);
+    timeval tv{};
+    tv.tv_sec = static_cast<time_t>(ack_ms / 1000);
+    tv.tv_usec = static_cast<suseconds_t>((ack_ms % 1000) * 1000);
+    ::setsockopt(cp->sock_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    char hdr_buf[kFrameHeaderBytes];
+    FrameHeader ack;
+    if (!RecvAll(cp->sock_, hdr_buf, kFrameHeaderBytes)) {
+      ::close(cp->sock_);
+      cp->sock_ = -1;
+      soft_err = "no hello ack (dead, re-forming, or overloaded "
+                 "coordinator)";
+      continue;
+    }
+    DecodeFrameHeader(hdr_buf, &ack);
+    if (ack.magic != kFrameMagic) {
+      *err = "hello ack had a bad frame magic — corrupted stream or "
+             "mixed-build coordinator";
       return nullptr;
     }
-    backoff.Sleep(attempt, left);
-  }
-  std::string hello(4, '\0');
-  int32_t r32 = rank;
-  std::memcpy(hello.data(), &r32, 4);
-  if (!cp->SendTypedFrame(cp->sock_, FrameType::HELLO, hello, 0)) {
-    *err = "hello send failed";
-    return nullptr;
-  }
-  // Await the HELLO_ACK: empty payload = admitted; non-empty = the
-  // coordinator's structured rejection (version skew and friends).  The
-  // read shares what remains of the rendezvous budget.
-  auto ack_left = std::chrono::duration_cast<std::chrono::milliseconds>(
-      deadline - std::chrono::steady_clock::now());
-  timeval tv{};
-  tv.tv_sec = static_cast<time_t>(std::max<long long>(ack_left.count(), 100) /
-                                  1000);
-  tv.tv_usec = static_cast<suseconds_t>(
-      (std::max<long long>(ack_left.count(), 100) % 1000) * 1000);
-  ::setsockopt(cp->sock_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
-  char hdr_buf[kFrameHeaderBytes];
-  FrameHeader ack;
-  if (!RecvAll(cp->sock_, hdr_buf, kFrameHeaderBytes)) {
-    *err = "no hello ack from coordinator (dead coordinator, or a "
-           "pre-handshake build on the other side)";
-    return nullptr;
-  }
-  DecodeFrameHeader(hdr_buf, &ack);
-  if (ack.magic != kFrameMagic) {
-    *err = "hello ack had a bad frame magic — corrupted stream or "
-           "mixed-build coordinator";
-    return nullptr;
-  }
-  std::string ack_body(ack.payload_len, '\0');
-  if (ack.payload_len > kMaxFrameBytes ||
-      (ack.payload_len > 0 &&
-       !RecvAll(cp->sock_, ack_body.data(), ack_body.size()))) {
-    *err = "truncated hello ack";
-    return nullptr;
-  }
-  if (ack.version != cp->wire_version_) {
-    *err = "protocol version skew with the coordinator: this rank speaks v" +
-           std::to_string(cp->wire_version_) + ", coordinator speaks v" +
-           std::to_string(ack.version) +
-           (ack_body.empty() ? "" : " (" + ack_body + ")");
-    return nullptr;
-  }
-  if (!ack_body.empty()) {
-    *err = ack_body;  // coordinator's structured rejection
-    return nullptr;
+    std::string ack_body(ack.payload_len, '\0');
+    if (ack.payload_len > kMaxFrameBytes ||
+        (ack.payload_len > 0 &&
+         !RecvAll(cp->sock_, ack_body.data(), ack_body.size()))) {
+      ::close(cp->sock_);
+      cp->sock_ = -1;
+      soft_err = "truncated hello ack";
+      continue;
+    }
+    if (ack.version != cp->wire_version_) {
+      *err = "protocol version skew with the coordinator: this rank speaks "
+             "v" + std::to_string(cp->wire_version_) +
+             ", coordinator speaks v" + std::to_string(ack.version) +
+             (ack_body.empty() ? "" : " (" + ack_body + ")");
+      return nullptr;
+    }
+    if (ack.flags != cp->epoch_) {
+      *err = "membership epoch skew with the coordinator: this rank speaks "
+             "epoch " + std::to_string(cp->epoch_) + ", coordinator speaks "
+             "epoch " + std::to_string(ack.flags) +
+             " (an elastic reconfiguration happened; rejoin via JOIN)";
+      return nullptr;
+    }
+    if (!ack_body.empty()) {
+      *err = ack_body;  // coordinator's structured rejection
+      return nullptr;
+    }
+    break;  // admitted
   }
   timeval zero{};
   ::setsockopt(cp->sock_, SOL_SOCKET, SO_RCVTIMEO, &zero, sizeof(zero));
@@ -420,6 +483,7 @@ TcpControlPlane::~TcpControlPlane() {
   if (sock_ >= 0) ::close(sock_);
   for (int fd : worker_fds_)
     if (fd >= 0) ::close(fd);
+  if (join_fd_ >= 0) ::close(join_fd_);
   if (listen_fd_ >= 0) ::close(listen_fd_);
 }
 
@@ -474,6 +538,30 @@ void TcpControlPlane::RecordAbort(const PeerFailureReport& report) {
   failed_.store(true);
 }
 
+void TcpControlPlane::RecordReconfig(const ReconfigInfo& info) {
+  std::lock_guard<std::mutex> l(state_mu_);
+  if (failed_.load()) return;  // a real failure verdict wins
+  reconfig_ = info;
+  // The failure record doubles as observability (hvd.failure_report()
+  // still names the removed rank) and as the interrupt flag that breaks
+  // blocked reads/polls; the engine consults GetReconfig FIRST.
+  failure_.failed_rank = info.failed_rank;
+  failure_.cause = info.cause.empty() ? "membership_reconfig" : info.cause;
+  failure_.detail =
+      "membership reconfiguration broadcast by the coordinator (epoch " +
+      std::to_string(info.epoch) + ", new size " +
+      std::to_string(info.new_size) + ")";
+  reconfigured_.store(true);
+  failed_.store(true);
+}
+
+bool TcpControlPlane::GetReconfig(ReconfigInfo* out) const {
+  std::lock_guard<std::mutex> l(state_mu_);
+  if (!reconfigured_.load()) return false;
+  *out = reconfig_;
+  return true;
+}
+
 bool TcpControlPlane::GetFailure(PeerFailureReport* out) const {
   std::lock_guard<std::mutex> l(state_mu_);
   if (!failed_.load()) return false;
@@ -509,6 +597,7 @@ bool TcpControlPlane::SendTypedFrame(int fd, FrameType type,
   FrameHeader h;
   h.version = wire_version_;
   h.type = static_cast<uint8_t>(type);
+  h.flags = epoch_;  // every frame is stamped with the membership epoch
   h.payload_len = static_cast<uint32_t>(payload.size());
   h.crc32 = Crc32(payload.data(), payload.size());
   const std::string* body = &payload;
@@ -576,6 +665,15 @@ bool TcpControlPlane::RecvDataFrame(int fd, int peer_rank, FrameType expect,
                         std::to_string(h.version));
       return false;
     }
+    if (h.flags != epoch_) {
+      RecordFailure(peer_rank, "stale_epoch",
+                    "frame from rank " + std::to_string(peer_rank) +
+                        " stamped with membership epoch " +
+                        std::to_string(h.flags) + " but this plane speaks "
+                        "epoch " + std::to_string(epoch_) +
+                        " (straggler from a pre-reconfiguration membership)");
+      return false;
+    }
     if (h.payload_len > kMaxFrameBytes) {
       RecordFailure(peer_rank, "frame_corrupt",
                     "absurd frame length from rank " +
@@ -616,6 +714,21 @@ bool TcpControlPlane::RecvDataFrame(int fd, int peer_rank, FrameType expect,
       } else {
         RecordFailure(peer_rank, "frame_corrupt",
                       "undecodable ABORT frame from rank " +
+                          std::to_string(peer_rank));
+      }
+      return false;
+    }
+    if (t == FrameType::RECONFIG) {
+      // Elastic membership change: the coordinator is reshaping the job
+      // instead of tearing it down.  Recorded like a failure (the blocked
+      // transport call returns false) but the engine consults GetReconfig
+      // first and shrinks in place rather than exiting.
+      ReconfigInfo info;
+      if (Deserialize(body.data(), body.size(), &info)) {
+        RecordReconfig(info);
+      } else {
+        RecordFailure(peer_rank, "frame_corrupt",
+                      "undecodable RECONFIG frame from rank " +
                           std::to_string(peer_rank));
       }
       return false;
@@ -692,6 +805,88 @@ void TcpControlPlane::AbortPeers(const PeerFailureReport& report) {
   } else if (sock_ >= 0) {
     SendTypedFrame(sock_, FrameType::ABORT, payload, 0);
   }
+}
+
+void TcpControlPlane::BroadcastReconfig(const ReconfigInfo& info) {
+  if (!coordinator_) return;
+  std::string payload;
+  Serialize(info, &payload);
+  for (size_t i = 0; i < worker_fds_.size(); ++i) {
+    if (worker_fds_[i] < 0) continue;
+    // Best effort, the removed rank included: a live-but-misbehaving rank
+    // learns it was expelled (new_ranks[r] == -1) and takes the legacy
+    // restartable-exit path; a dead one just errors the send.
+    SendTypedFrame(worker_fds_[i], FrameType::RECONFIG, payload,
+                   static_cast<int>(i) + 1);
+  }
+}
+
+int TcpControlPlane::PollJoinRequest() {
+  if (!coordinator_) return -1;
+  int lfd;
+  {
+    std::lock_guard<std::mutex> l(state_mu_);
+    if (join_fd_ >= 0) return join_id_;  // parked, awaiting its ticket
+    lfd = listen_fd_;
+  }
+  if (lfd < 0) return -1;
+  pollfd pfd{lfd, POLLIN, 0};
+  if (::poll(&pfd, 1, 0) <= 0 || (pfd.revents & POLLIN) == 0) return -1;
+  int fd = ::accept(lfd, nullptr, nullptr);
+  if (fd < 0) return -1;
+  // Bounded read of the JOIN frame: a stray connection that never speaks
+  // must not wedge the monitor thread.
+  timeval tv{};
+  tv.tv_sec = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  char hdr_buf[kFrameHeaderBytes];
+  FrameHeader h;
+  std::string body;
+  if (!RecvAll(fd, hdr_buf, kFrameHeaderBytes)) {
+    ::close(fd);
+    return -1;
+  }
+  DecodeFrameHeader(hdr_buf, &h);
+  if (h.magic != kFrameMagic ||
+      h.type != static_cast<uint8_t>(FrameType::JOIN) ||
+      h.payload_len != 4) {
+    ::close(fd);  // not a joiner (port scanner, stale straggler): drop it
+    return -1;
+  }
+  body.resize(4);
+  if (!RecvAll(fd, body.data(), 4) || Crc32(body.data(), 4) != h.crc32) {
+    ::close(fd);
+    return -1;
+  }
+  int32_t id = -1;
+  std::memcpy(&id, body.data(), 4);
+  std::lock_guard<std::mutex> l(state_mu_);
+  join_fd_ = fd;
+  join_id_ = id;
+  return id;
+}
+
+void TcpControlPlane::CloseListener() {
+  std::lock_guard<std::mutex> l(state_mu_);
+  if (listen_fd_ >= 0) {
+    ::close(listen_fd_);
+    listen_fd_ = -1;
+  }
+}
+
+void TcpControlPlane::SendJoinTicket(const JoinTicket& ticket) {
+  int fd;
+  {
+    std::lock_guard<std::mutex> l(state_mu_);
+    fd = join_fd_;
+    join_fd_ = -1;
+    join_id_ = -1;
+  }
+  if (fd < 0) return;
+  std::string payload;
+  Serialize(ticket, &payload);
+  SendTypedFrame(fd, FrameType::JOIN_ACK, payload, -1);
+  ::close(fd);  // the joiner reconnects as a normal worker at the new epoch
 }
 
 bool TcpControlPlane::Exchange(const RequestList& send, ResponseList* recv) {
@@ -810,6 +1005,15 @@ bool TcpControlPlane::Gather(const RequestList& own,
                               std::to_string(wrank) + ": local v" +
                               std::to_string(wire_version_) + ", peer v" +
                               std::to_string(f.hdr.version));
+            return false;
+          }
+          if (f.hdr.flags != epoch_) {
+            RecordFailure(wrank, "stale_epoch",
+                          "frame from rank " + std::to_string(wrank) +
+                              " stamped with membership epoch " +
+                              std::to_string(f.hdr.flags) +
+                              " but this plane speaks epoch " +
+                              std::to_string(epoch_));
             return false;
           }
           if (f.hdr.payload_len > kMaxFrameBytes) {

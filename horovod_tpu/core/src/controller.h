@@ -56,6 +56,29 @@ class ControlPlane {
   // worker, best effort — survivors fail their pending collectives with
   // the report instead of waiting out the stall window.
   virtual void AbortPeers(const PeerFailureReport& /*report*/) {}
+
+  // Elastic membership reconfiguration (HVD_TPU_ELASTIC=1;
+  // docs/fault_tolerance.md "In-place recovery").
+  // Worker side: a RECONFIG frame received while blocked on the
+  // coordinator is recorded here (the transport call returns false, like a
+  // failure; the engine consults GetReconfig BEFORE GetFailure).
+  virtual bool GetReconfig(ReconfigInfo* /*out*/) const { return false; }
+  // Coordinator: broadcast the reconfiguration verdict to every connected
+  // worker (the expelled rank included — it learns it was expelled from
+  // new_ranks[old_rank] == -1 and takes the legacy abort path).
+  virtual void BroadcastReconfig(const ReconfigInfo& /*info*/) {}
+  // Coordinator: non-blocking check for a relaunched rank knocking on the
+  // listen socket with a JOIN frame.  Returns the joiner's advertised id
+  // (its pre-failure rank, informational) or -1; the connection is parked
+  // until SendJoinTicket answers it.
+  virtual int PollJoinRequest() { return -1; }
+  virtual void SendJoinTicket(const JoinTicket& /*ticket*/) {}
+  // Coordinator, reconfiguration hand-off: close ONLY the listen socket so
+  // the re-formed membership can bind the same port, while the old peer
+  // sockets stay open (absorbing stray heartbeats from survivors that have
+  // not processed the RECONFIG broadcast yet — closing them would RST the
+  // peer and flush the un-read verdict out of its receive queue).
+  virtual void CloseListener() {}
 };
 
 // Single-process transport: Exchange/Gather/Broadcast are pass-throughs.
@@ -87,10 +110,16 @@ class TcpControlPlane : public ControlPlane {
  public:
   // Coordinator: bind+listen on port, accept size-1 workers (identified by a
   // hello frame carrying their rank).  Worker: connect to host:port.
+  // ``epoch`` is the membership epoch this plane speaks (0 for the initial
+  // membership): stamped into every frame header and enforced at the HELLO
+  // handshake, so stragglers from an older membership are rejected instead
+  // of admitted.
   static std::unique_ptr<TcpControlPlane> MakeCoordinator(int port, int size,
+                                                          int64_t epoch,
                                                           std::string* err);
   static std::unique_ptr<TcpControlPlane> MakeWorker(const std::string& host,
                                                      int port, int rank,
+                                                     int64_t epoch,
                                                      std::string* err);
   ~TcpControlPlane() override;
 
@@ -104,16 +133,26 @@ class TcpControlPlane : public ControlPlane {
   bool GetFailure(PeerFailureReport* out) const override;
   void AbortPeers(const PeerFailureReport& report) override;
 
+  bool GetReconfig(ReconfigInfo* out) const override;
+  void BroadcastReconfig(const ReconfigInfo& info) override;
+  int PollJoinRequest() override;
+  void SendJoinTicket(const JoinTicket& ticket) override;
+  void CloseListener() override;
+
   // Env-driven wire-level chaos injection (faults.py table;
-  // HVD_TPU_FAULT_WIRE_{DROP,CORRUPT,PARTITION,HALFCLOSE}="<rank>[:<frame>]",
-  // gated on HVD_TPU_RESTART_ATTEMPT == HVD_TPU_FAULT_ON_ATTEMPT like every
-  // other injector).  The named rank misbehaves from its <frame>-th sent
-  // frame on; all other ranks run clean and must detect + abort.
+  // HVD_TPU_FAULT_WIRE_{DROP,CORRUPT,PARTITION,HALFCLOSE} =
+  // "<rank>[:<frame>][@<epoch>]", gated on HVD_TPU_RESTART_ATTEMPT ==
+  // HVD_TPU_FAULT_ON_ATTEMPT like every other injector).  The named rank
+  // misbehaves from its <frame>-th sent frame on, but only while the
+  // control plane speaks membership epoch <epoch> (default 0) — so an
+  // elastic job that shrank past the fault runs clean at the new epoch
+  // instead of re-tripping the same injector forever.
   struct WireFaultSpec {
     enum class Mode { NONE, DROP, CORRUPT, PARTITION, HALFCLOSE };
     Mode mode = Mode::NONE;
     int rank = -1;
     long long frame = 0;
+    long long epoch = 0;
   };
 
  private:
@@ -129,6 +168,7 @@ class TcpControlPlane : public ControlPlane {
                      std::string* payload);
   void RecordFailure(int peer_rank, const char* cause, std::string detail);
   void RecordAbort(const PeerFailureReport& report);
+  void RecordReconfig(const ReconfigInfo& info);
   void NoteRx(int peer_rank);
   double SecondsSinceRx(int peer_rank) const;
   bool PartitionActive() const;
@@ -152,6 +192,13 @@ class TcpControlPlane : public ControlPlane {
   std::vector<std::chrono::steady_clock::time_point> last_rx_;  // peer index
   PeerFailureReport failure_;
   std::atomic<bool> failed_{false};
+  // Elastic state (guarded by state_mu_): a received RECONFIG verdict, and
+  // a parked JOIN connection awaiting its ticket (coordinator only).
+  ReconfigInfo reconfig_;
+  std::atomic<bool> reconfigured_{false};
+  int join_fd_ = -1;
+  int join_id_ = -1;
+  uint16_t epoch_ = 0;  // membership epoch stamped into frame flags
 
   uint8_t wire_version_ = kWireVersion;  // HVD_TPU_WIRE_VERSION override
   WireFaultSpec fault_;

@@ -47,7 +47,7 @@ Status Engine::Start(int* bound_port) {
   } else if (opts_.rank == 0) {
     std::string err;
     auto cp = TcpControlPlane::MakeCoordinator(opts_.coordinator_port,
-                                               opts_.size, &err);
+                                               opts_.size, opts_.epoch, &err);
     if (!cp) return Status::Unknown("control plane: " + err);
     if (bound_port != nullptr) *bound_port = cp->bound_port();
     control_ = std::move(cp);
@@ -55,7 +55,7 @@ Status Engine::Start(int* bound_port) {
     std::string err;
     auto cp = TcpControlPlane::MakeWorker(opts_.coordinator_host,
                                           opts_.coordinator_port, opts_.rank,
-                                          &err);
+                                          opts_.epoch, &err);
     if (!cp) return Status::Unknown("control plane: " + err);
     control_ = std::move(cp);
   }
@@ -527,8 +527,20 @@ void Engine::MonitorLoop() {
       });
     }
     if (stopped_.load() || shutdown_requested_.load()) return;
+    if (opts_.elastic && control_->is_coordinator() && MaybeHandleJoin()) {
+      // A relaunched rank was admitted: this engine just reconfigured
+      // itself away; the Python layer re-forms it at the grown size.
+      return;
+    }
     if (!control_->HeartbeatTick(opts_.heartbeat_timeout_ms / 1000.0)) {
       continue;
+    }
+    ReconfigInfo info;
+    if (control_->GetReconfig(&info)) {
+      // The cycle thread's blocked read demuxed a RECONFIG verdict and the
+      // failure flag it raises woke us: shrink in place, don't abort.
+      HandleReconfig(info);
+      return;
     }
     PeerFailureReport report;
     control_->GetFailure(&report);
@@ -538,6 +550,11 @@ void Engine::MonitorLoop() {
 }
 
 void Engine::HandleTransportFailure(const char* what) {
+  ReconfigInfo info;
+  if (!shutdown_requested_.load() && control_->GetReconfig(&info)) {
+    HandleReconfig(info);
+    return;
+  }
   PeerFailureReport report;
   if (!shutdown_requested_.load() && control_->GetFailure(&report)) {
     HandlePeerFailure(std::move(report));
@@ -554,6 +571,39 @@ void Engine::HandleTransportFailure(const char* what) {
 void Engine::HandlePeerFailure(PeerFailureReport report) {
   bool expected = false;
   if (!failure_handled_.compare_exchange_strong(expected, true)) return;
+  // Elastic shrink decision (coordinator only — workers never observe a
+  // non-coordinator peer directly; they receive the RECONFIG verdict).  A
+  // dead COORDINATOR, or a shrink below the HVD_TPU_MIN_SIZE floor, keeps
+  // the legacy abort-and-restart path; coordinator failover is out of
+  // scope (docs/fault_tolerance.md recovery-mode matrix).
+  if (opts_.elastic && control_->is_coordinator() && report.failed_rank > 0 &&
+      report.failed_rank < opts_.size &&
+      opts_.size - 1 >= std::max(opts_.min_size, 1) &&
+      !shutdown_requested_.load()) {
+    ReconfigInfo info;
+    info.epoch = opts_.epoch + 1;
+    info.new_size = opts_.size - 1;
+    info.failed_rank = report.failed_rank;
+    info.cause = report.cause;
+    info.new_ranks.resize(static_cast<size_t>(opts_.size));
+    for (int r = 0; r < opts_.size; ++r) {
+      info.new_ranks[static_cast<size_t>(r)] =
+          r == report.failed_rank ? -1 : (r > report.failed_rank ? r - 1 : r);
+    }
+    {
+      // Keep the failure observable (hvd.failure_report() names the dead
+      // rank even when the job survives it).
+      std::lock_guard<std::mutex> l(mu_);
+      failure_ = report;
+    }
+    control_->BroadcastReconfig(info);
+    ReconfigEndgame(info);
+    return;
+  }
+  AbortEndgame(std::move(report));
+}
+
+void Engine::AbortEndgame(PeerFailureReport report) {
   {
     std::lock_guard<std::mutex> l(mu_);
     if (report.last_collective.empty() && !inflight_.empty()) {
@@ -606,6 +656,144 @@ void Engine::HandlePeerFailure(PeerFailureReport report) {
     std::fflush(stderr);
     std::_Exit(opts_.stall_abort_exit_code);
   }
+}
+
+void Engine::HandleReconfig(const ReconfigInfo& info) {
+  bool expected = false;
+  if (!failure_handled_.compare_exchange_strong(expected, true)) return;
+  ReconfigEndgame(info);
+}
+
+void Engine::ReconfigEndgame(const ReconfigInfo& info) {
+  int32_t new_rank = -1;
+  if (opts_.rank >= 0 &&
+      static_cast<size_t>(opts_.rank) < info.new_ranks.size()) {
+    new_rank = info.new_ranks[static_cast<size_t>(opts_.rank)];
+  }
+  if (new_rank < 0) {
+    // WE are the rank being removed (live but misbehaving — wire faults,
+    // a partitioned half): the new membership excludes us, so take the
+    // legacy restartable-exit path; the supervisor relaunches us and the
+    // relaunch JOINs back in.
+    PeerFailureReport report;
+    report.failed_rank = opts_.rank;
+    report.cause = info.cause.empty() ? "membership_reconfig" : info.cause;
+    report.detail = "this rank was removed from the job by an elastic "
+                    "reconfiguration (epoch " + std::to_string(info.epoch) +
+                    "); exiting restartably to rejoin";
+    AbortEndgame(std::move(report));
+    return;
+  }
+  {
+    std::lock_guard<std::mutex> l(mu_);
+    resize_.present = true;
+    resize_.epoch = info.epoch;
+    resize_.old_rank = opts_.rank;
+    resize_.new_rank = new_rank;
+    resize_.old_size = opts_.size;
+    resize_.new_size = info.new_size;
+    resize_.failed_rank = info.failed_rank;
+    resize_.cause = info.cause;
+    // Coordinated flush, the PR-3 cache_clear semantics: the new
+    // membership renegotiates everything from scratch — a cached verdict
+    // sized for the old membership must never be served again.
+    if (cache_.enabled()) cache_.Clear();
+    pending_verify_.clear();
+  }
+  std::ostringstream msg;
+  msg << "Membership changed (elastic reconfiguration): ";
+  if (info.failed_rank >= 0) {
+    msg << "rank " << info.failed_rank << " left (" << info.cause << ")";
+  } else {
+    msg << "a relaunched rank rejoined";
+  }
+  msg << "; new size " << info.new_size << ", epoch " << info.epoch
+      << ", this rank is now rank " << new_rank
+      << ". Pending collectives were aborted and must be reissued after "
+         "reconfiguration; hvd.resize_event() has the structured event.";
+  std::string text = msg.str();
+  std::fprintf(stderr, "NOTICE: horovod_tpu %s\n", text.c_str());
+  std::fflush(stderr);
+  if (timeline_.Initialized()) {
+    timeline_.Instant("control_plane", "RECONFIG");
+  }
+  FailAllPending(Status::PreconditionError(text));
+  stopped_.store(true);
+  exec_cv_.notify_all();
+  cycle_cv_.notify_all();
+  monitor_cv_.notify_all();
+  AwaitResizeAckOrDie();
+}
+
+void Engine::AwaitResizeAckOrDie() {
+  // Bounded hand-off to Python (HVD_TPU_RECONFIG_TIMEOUT_MS): the resize
+  // event was published and this engine is stopped; if no one picks the
+  // event up — the script is not elastic-aware, or is wedged — fall back
+  // to the abort-and-restart path rather than idling forever (the PR-4
+  // nothing-blocks-forever contract).  Runs on the cycle or monitor
+  // thread; AckResize (or a deliberate Shutdown) releases it quickly, so
+  // the engine destructor's joins stay fast.
+  auto deadline =
+      std::chrono::steady_clock::now() +
+      std::chrono::duration<double, std::milli>(
+          opts_.reconfig_timeout_ms > 0 ? opts_.reconfig_timeout_ms : 30000.0);
+  while (std::chrono::steady_clock::now() < deadline) {
+    if (resize_acked_.load() || shutdown_requested_.load()) return;
+    std::this_thread::sleep_for(std::chrono::milliseconds(20));
+  }
+  std::fprintf(stderr,
+               "ERROR: horovod_tpu elastic reconfiguration was not "
+               "acknowledged within HVD_TPU_RECONFIG_TIMEOUT_MS; falling "
+               "back to full restart with exit code %d\n",
+               opts_.stall_abort_exit_code);
+  std::fflush(stderr);
+  std::_Exit(opts_.stall_abort_exit_code);
+}
+
+bool Engine::MaybeHandleJoin() {
+  int joiner = control_->PollJoinRequest();
+  if (joiner < 0) return false;
+  bool expected = false;
+  if (!failure_handled_.compare_exchange_strong(expected, true)) {
+    return true;  // already aborting/reconfiguring: the joiner retries
+  }
+  // Grow reconfiguration: existing members keep their ranks, the joiner is
+  // appended at new_size - 1 and admitted at this boundary (it learns its
+  // identity from the JoinTicket, then rendezvous like any worker).
+  ReconfigInfo info;
+  info.epoch = opts_.epoch + 1;
+  info.new_size = opts_.size + 1;
+  info.failed_rank = -1;
+  info.cause = "join";
+  info.new_ranks.resize(static_cast<size_t>(opts_.size));
+  for (int r = 0; r < opts_.size; ++r) {
+    info.new_ranks[static_cast<size_t>(r)] = r;
+  }
+  std::fprintf(stderr,
+               "NOTICE: horovod_tpu admitting rejoining rank (was rank %d) "
+               "as rank %d at epoch %lld\n",
+               joiner, info.new_size - 1,
+               static_cast<long long>(info.epoch));
+  std::fflush(stderr);
+  JoinTicket ticket;
+  ticket.epoch = info.epoch;
+  ticket.new_size = info.new_size;
+  ticket.assigned_rank = info.new_size - 1;
+  control_->SendJoinTicket(ticket);
+  control_->BroadcastReconfig(info);
+  ReconfigEndgame(info);
+  return true;
+}
+
+Engine::ResizeEventView Engine::ResizeEvent() {
+  std::lock_guard<std::mutex> l(mu_);
+  return resize_;
+}
+
+void Engine::AckResize() { resize_acked_.store(true); }
+
+void Engine::DetachListener() {
+  if (control_) control_->CloseListener();
 }
 
 void Engine::FailUnscheduled(const Status& status) {

@@ -89,6 +89,21 @@ struct EngineOptions {
   // the PR-1 supervisor restarts the job even if the script is wedged.
   // < 0: report only, never exit (debugging).
   double abort_grace_ms = 1000.0;
+  // In-place elastic recovery (HVD_TPU_ELASTIC=1, docs/fault_tolerance.md
+  // "In-place recovery"): when a NON-coordinator rank dies and at least
+  // min_size ranks survive, the coordinator broadcasts a RECONFIG verdict
+  // instead of ABORT and every survivor publishes a resize event (failing
+  // in-flight collectives, flushing the response cache) rather than
+  // exiting — the Python layer re-forms the engine under the new
+  // membership in the same process.  Coordinator death, or a shrink below
+  // min_size, falls back to the legacy abort-and-restart path.  The whole
+  // reconfiguration is bounded: a survivor whose Python never acknowledges
+  // the resize within reconfig_timeout_ms exits restartably (75), keeping
+  // the PR-4 nothing-blocks-forever guarantee.
+  bool elastic = false;
+  int min_size = 1;
+  double reconfig_timeout_ms = 30000.0;
+  int64_t epoch = 0;              // membership epoch this engine speaks
   std::string timeline_path;      // empty = disabled
   std::string coordinator_host;   // workers (rank>0)
   int coordinator_port = 0;       // 0 = pick ephemeral (coordinator)
@@ -157,6 +172,29 @@ class Engine {
   // peer failure has been detected.
   PeerFailureReport FailureReport();
 
+  // Elastic resize event (hvd.resize_event() in Python): present after a
+  // membership reconfiguration verdict reached this rank — the engine is
+  // stopped, in-flight collectives were failed with a MembershipChanged
+  // error, and the Python layer must AckResize() and re-form a new engine
+  // at {epoch, new_rank, new_size}.  An un-acked resize exits restartably
+  // after reconfig_timeout_ms (fallback to the full-restart path).
+  struct ResizeEventView {
+    bool present = false;
+    int64_t epoch = 0;
+    int32_t old_rank = -1;
+    int32_t new_rank = -1;
+    int32_t old_size = 0;
+    int32_t new_size = 0;
+    int32_t failed_rank = -1;  // -1 for a grow (join)
+    std::string cause;
+  };
+  ResizeEventView ResizeEvent();
+  void AckResize();
+  // Reconfiguration hand-off (coordinator): free the listen port for the
+  // re-formed membership while keeping old peer sockets open — see
+  // ControlPlane::CloseListener.
+  void DetachListener();
+
   // Handle table (reference torch/handle_manager.{h,cc}).
   bool PollHandle(int64_t handle);                 // true = done
   // Block until the handle completes (condvar wait, not a poll loop).
@@ -183,8 +221,27 @@ class Engine {
   // Idempotent peer-failure endgame: publish the report, broadcast ABORT
   // (coordinator), fail every pending collective with a CollectiveError
   // naming the failed rank, emit timeline instants, and — after
-  // abort_grace_ms — exit the process with the restartable code.
+  // abort_grace_ms — exit the process with the restartable code.  Under
+  // HVD_TPU_ELASTIC the coordinator reroutes a survivable non-coordinator
+  // death to ReconfigEndgame (shrink in place) instead.
   void HandlePeerFailure(PeerFailureReport report);
+  // The legacy post-CAS abort body (report published, ABORT broadcast,
+  // collectives failed, grace exit) — shared by HandlePeerFailure and the
+  // expelled-rank RECONFIG path.
+  void AbortEndgame(PeerFailureReport report);
+  // A RECONFIG verdict reached this rank (worker transport demux, or the
+  // coordinator's own elastic decision): CAS-guarded entry point.
+  void HandleReconfig(const ReconfigInfo& info);
+  // Post-CAS reconfiguration body: publish the resize event, flush the
+  // response cache (the PR-3 cache_clear semantics), fail in-flight
+  // collectives with a MembershipChanged error, stop the engine, and wait
+  // (bounded by reconfig_timeout_ms) for Python's AckResize — expiry falls
+  // back to the restartable exit.
+  void ReconfigEndgame(const ReconfigInfo& info);
+  void AwaitResizeAckOrDie();
+  // Coordinator + elastic: admit a pending JOIN request by triggering a
+  // grow reconfiguration.  Returns true when a reconfiguration fired.
+  bool MaybeHandleJoin();
   void DispatchResponses(const ResponseList& responses);
   void HandleDivergence(const std::vector<DivergenceEntry>& entries);
   // Coordinated-shutdown teardown: abort tensors still negotiating, but let
@@ -228,6 +285,8 @@ class Engine {
   std::vector<VerifyEntry> pending_verify_;      // guarded by mu_
   std::vector<DivergenceEntry> divergence_;      // guarded by mu_
   PeerFailureReport failure_;                    // guarded by mu_
+  ResizeEventView resize_;                       // guarded by mu_
+  std::atomic<bool> resize_acked_{false};
   int64_t verify_tick_ = 0;   // background thread only
   int64_t next_handle_ = 0;
   int64_t next_batch_id_ = 0;

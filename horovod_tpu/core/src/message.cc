@@ -321,4 +321,42 @@ bool Deserialize(const char* data, size_t len, PeerFailureReport* out) {
   return !r.fail;
 }
 
+void Serialize(const ReconfigInfo& in, std::string* out) {
+  Writer w{out};
+  w.i64(in.epoch);
+  w.i32(in.new_size);
+  w.i32(in.failed_rank);
+  w.str(in.cause);
+  w.i32(static_cast<int32_t>(in.new_ranks.size()));
+  for (int32_t r : in.new_ranks) w.i32(r);
+}
+
+bool Deserialize(const char* data, size_t len, ReconfigInfo* out) {
+  Reader r{data, len};
+  out->epoch = r.i64();
+  out->new_size = r.i32();
+  out->failed_rank = r.i32();
+  out->cause = r.str();
+  int32_t n = r.i32();
+  if (r.fail || n < 0 || static_cast<size_t>(n) > kMaxVector) return false;
+  out->new_ranks.resize(n);
+  for (int32_t i = 0; i < n; ++i) out->new_ranks[i] = r.i32();
+  return !r.fail;
+}
+
+void Serialize(const JoinTicket& in, std::string* out) {
+  Writer w{out};
+  w.i64(in.epoch);
+  w.i32(in.new_size);
+  w.i32(in.assigned_rank);
+}
+
+bool Deserialize(const char* data, size_t len, JoinTicket* out) {
+  Reader r{data, len};
+  out->epoch = r.i64();
+  out->new_size = r.i32();
+  out->assigned_rank = r.i32();
+  return !r.fail;
+}
+
 }  // namespace hvd

@@ -157,14 +157,24 @@ enum class FrameType : uint8_t {
   RESPONSE = 4,   // ResponseList (coordinator -> workers)
   HEARTBEAT = 5,  // empty liveness frame (monitor threads, both directions)
   ABORT = 6,      // PeerFailureReport: coordinated job abort
+  RECONFIG = 7,   // ReconfigInfo: elastic membership change (coordinator ->
+                  // workers; docs/fault_tolerance.md "In-place recovery")
+  JOIN = 8,       // {i32 id}: a relaunched rank asking to be admitted
+  JOIN_ACK = 9,   // JoinTicket: admission verdict for a JOIN
 };
 
-// 16-byte little-endian header preceding every frame payload.
+// 16-byte little-endian header preceding every frame payload.  ``flags``
+// carries the membership epoch (low 16 bits): every elastic
+// reconfiguration bumps it, and both sides reject frames stamped with a
+// different epoch as ``stale_epoch`` — a straggler from a pre-shrink
+// membership can never smuggle requests into the new one.  Epoch 0 (the
+// only epoch of a non-elastic job) keeps the field's historical all-zero
+// encoding, so the wire version does not change.
 struct FrameHeader {
   uint32_t magic = kFrameMagic;
   uint8_t version = kWireVersion;
   uint8_t type = 0;
-  uint16_t flags = 0;  // reserved
+  uint16_t flags = 0;  // membership epoch (mod 2^16); 0 before any resize
   uint32_t payload_len = 0;
   uint32_t crc32 = 0;  // CRC-32 (IEEE) of the payload bytes
 };
@@ -193,5 +203,36 @@ struct PeerFailureReport {
 
 void Serialize(const PeerFailureReport& in, std::string* out);
 bool Deserialize(const char* data, size_t len, PeerFailureReport* out);
+
+// Elastic membership reconfiguration (docs/fault_tolerance.md "In-place
+// recovery", HVD_TPU_ELASTIC=1): the coordinator's verdict when a
+// non-coordinator rank dies (shrink) or a relaunched rank asks to rejoin
+// (grow).  Broadcast as a RECONFIG frame; every survivor fails in-flight
+// collectives, flushes its response-cache replica, and re-forms the
+// control plane under the new epoch/size/rank without exiting.
+struct ReconfigInfo {
+  int64_t epoch = 0;        // the NEW membership epoch (old + 1)
+  int32_t new_size = 0;     // surviving/expanded job size
+  int32_t failed_rank = -1; // the removed rank; -1 for a pure grow
+  std::string cause;        // PeerFailureReport cause, or "join"
+  // Contiguous re-assignment, indexed by OLD rank: new_ranks[r] is rank
+  // r's identity in the new membership, -1 when expelled.  A grow appends
+  // the joiner at new_size - 1 (it learns that from its JoinTicket).
+  std::vector<int32_t> new_ranks;
+};
+
+void Serialize(const ReconfigInfo& in, std::string* out);
+bool Deserialize(const char* data, size_t len, ReconfigInfo* out);
+
+// Admission verdict sent to a JOINing rank: the epoch and size of the
+// membership it will rendezvous into, and the rank it was assigned.
+struct JoinTicket {
+  int64_t epoch = 0;
+  int32_t new_size = 0;
+  int32_t assigned_rank = -1;
+};
+
+void Serialize(const JoinTicket& in, std::string* out);
+bool Deserialize(const char* data, size_t len, JoinTicket* out);
 
 }  // namespace hvd

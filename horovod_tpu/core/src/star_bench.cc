@@ -61,7 +61,7 @@ int main(int argc, char** argv) {
     workers.emplace_back([rank, port, ticks, names]() {
       std::string werr;
       auto w = hvd::TcpControlPlane::MakeWorker("127.0.0.1", port, rank,
-                                                &werr);
+                                                /*epoch=*/0, &werr);
       if (!w) {
         std::fprintf(stderr, "worker %d: %s\n", rank, werr.c_str());
         std::exit(1);
@@ -78,7 +78,8 @@ int main(int argc, char** argv) {
   }
 
   std::string err;
-  auto coord = hvd::TcpControlPlane::MakeCoordinator(port, p, &err);
+  auto coord = hvd::TcpControlPlane::MakeCoordinator(port, p, /*epoch=*/0,
+                                                     &err);
   if (!coord) {
     std::fprintf(stderr, "coordinator: %s\n", err.c_str());
     // exit(), not return: worker threads are joinable, and destroying

@@ -1,0 +1,280 @@
+"""In-place elastic recovery — membership reconfiguration without restart.
+
+PR 4 made peer death *detection* fast (~100 ms); before this module the
+only *recovery* was "every survivor exits 75, the launcher tears the whole
+job down and relaunches from checkpoint" — full process teardown, JAX
+re-init, and checkpoint reload paid for every single lost worker.  With
+``HVD_TPU_ELASTIC=1`` (docs/fault_tolerance.md "In-place recovery") the
+control plane instead *shrinks to survive*, following the direction later
+Horovod (Elastic Horovod) and TorchElastic took:
+
+* the coordinator broadcasts a ``RECONFIG`` frame carrying the new
+  membership epoch: new size, contiguous re-assigned ranks, the failed
+  rank's identity (core/src/controller.cc);
+* every survivor fails only its in-flight collectives, flushes its
+  response-cache replica and verifier hashes (the PR-3 ``cache_clear``
+  path), and publishes a structured resize event — the engine stops but
+  the PROCESS lives;
+* :func:`reconfigure` (below) acknowledges the event, re-forms the native
+  engine under the new ``{epoch, rank, size}`` on the same coordinator
+  port, and fires every :func:`on_reconfigure` callback — data re-sharding
+  and LR re-scaling hooks;
+* every subsequent wire frame is stamped with the new epoch, so a
+  straggler from the old membership is rejected by the PR-4 hardened-frame
+  layer (``stale_epoch``) instead of corrupting the new one.
+
+The grow path is symmetric: the launcher (``python -m horovod_tpu.run
+--elastic``) relaunches only the dead rank, which calls :func:`join` —
+a ``JOIN``/``JOIN_ACK`` handshake against the coordinator's listen socket
+— and is admitted at the next reconfiguration boundary with a fresh rank.
+
+Scope and floors: ``HVD_TPU_MIN_SIZE`` sets the size below which the old
+full-restart path (exit 75) still applies; coordinator (rank 0) death also
+falls back to full restart — coordinator failover is explicitly out of
+scope.  Reconfiguration itself is bounded by
+``HVD_TPU_RECONFIG_TIMEOUT_MS``: an unacknowledged resize, or a
+re-rendezvous that cannot complete, falls back to abort-and-restart, so
+nothing ever blocks forever (the PR-4 guarantee).
+
+Data-plane caveat: the compiled SPMD path and the ``multihost`` eager
+executor ride ``jax.distributed``, whose process set cannot re-form inside
+a live process — elastic mode therefore serves the eager-engine path
+(engine-only workers, ``local`` executor semantics, torch/TF eager); mesh
+jobs should keep ``HVD_TPU_ELASTIC=0`` and the PR-1 full-restart story.
+
+jax-free by design: joiners and engine-only workers must reach their
+rendezvous without paying the jax import.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import socket
+import struct
+import time
+import zlib
+from typing import Callable, NamedTuple
+
+from horovod_tpu.core import engine as _engine_mod
+from horovod_tpu.utils import env
+
+# Re-exported for callers that catch the elastic signal directly.
+MembershipChanged = _engine_mod.MembershipChanged
+
+_FRAME_MAGIC = 0x48564446
+_WIRE_VERSION = 1
+_FRAME_JOIN = 8
+_FRAME_JOIN_ACK = 9
+
+
+@dataclasses.dataclass(frozen=True)
+class ResizeEvent:
+    """One applied membership change — what :func:`resize_event` returns
+    and what :func:`on_reconfigure` callbacks receive."""
+
+    epoch: int
+    old_rank: int
+    new_rank: int
+    old_size: int
+    new_size: int
+    failed_rank: int  # -1 for a grow (a relaunched rank rejoined)
+    cause: str
+
+    @property
+    def grew(self) -> bool:
+        return self.new_size > self.old_size
+
+
+class JoinTicket(NamedTuple):
+    """Admission verdict from :func:`join`: the membership the relaunched
+    rank will rendezvous into."""
+
+    epoch: int
+    new_size: int
+    assigned_rank: int
+
+
+def enabled() -> bool:
+    """True when in-place elastic recovery is on (``HVD_TPU_ELASTIC=1``)."""
+    return env.elastic_enabled()
+
+
+_callbacks: list[Callable[[ResizeEvent], None]] = []
+_last_event: ResizeEvent | None = None
+
+
+def on_reconfigure(callback: Callable[[ResizeEvent], None]):
+    """Register ``callback(event)`` to run after every successful
+    :func:`reconfigure` — the hook for re-sharding data and re-scaling the
+    learning rate to the new ``hvd.size()``.  Usable as a decorator;
+    returns the callback.  Values derived from ``hvd.size()``/``hvd.rank()``
+    cached outside such a callback go stale under elastic resize
+    (hvd-lint rule HVD106, docs/static_analysis.md)."""
+    _callbacks.append(callback)
+    return callback
+
+
+def resize_event() -> ResizeEvent | None:
+    """The most recent membership change: a pending (un-acked) event
+    published by a stopped engine takes precedence, else the last event
+    applied by :func:`reconfigure`, else ``None`` while the membership has
+    been stable since init — ``hvd.resize_event()``."""
+    raw = _engine_mod.resize_event()
+    if raw is not None:
+        return ResizeEvent(**raw)
+    return _last_event
+
+
+def attach(eng) -> None:
+    """Register an explicitly-constructed :class:`NativeEngine` as the
+    process's active engine so :func:`reconfigure` (and
+    ``training.elastic_loop``) can find and re-form it.  Engines created
+    through ``hvd.init()``'s lazy path are registered automatically."""
+    _engine_mod.replace_engine(None, eng)
+
+
+def reconfigure(eng=None) -> ResizeEvent:
+    """Apply a pending membership change in place: acknowledge the stopped
+    engine's resize event, tear the old engine down, and re-form it under
+    the new ``{epoch, rank, size}`` on the same coordinator port — all in
+    this same process (no exit, no relaunch, no JAX re-init).
+
+    Raises :class:`RuntimeError` when no resize event is pending, and
+    :class:`MembershipChanged` when this rank was expelled (its new rank is
+    -1 — the engine's legacy restartable exit is already scheduled).  The
+    re-rendezvous is bounded by ``HVD_TPU_RECONFIG_TIMEOUT_MS``; on expiry
+    the underlying connect error propagates and the supervisor's
+    full-restart path takes over.
+
+    Returns the applied :class:`ResizeEvent` after firing every
+    :func:`on_reconfigure` callback."""
+    global _last_event
+    if eng is None:
+        eng = _engine_mod.peek_engine()
+    if eng is None:
+        raise RuntimeError(
+            "no engine is running; elastic.reconfigure() applies a resize "
+            "event published by a stopped engine (see hvd.resize_event())")
+    raw = eng.resize_event()
+    if raw is None:
+        raise RuntimeError("no membership change is pending on this engine")
+    ev = ResizeEvent(**raw)
+    if ev.new_rank < 0:
+        raise MembershipChanged(
+            f"this rank was removed from the job at epoch {ev.epoch} "
+            f"({ev.cause}); it exits restartably and may rejoin via the "
+            f"launcher's --elastic relaunch")
+    # Stand the native reconfig-timeout fallback down FIRST: from here on
+    # this process owns the recovery.
+    eng.resize_ack()
+    ctor = dict(eng._ctor)
+    if ev.new_rank == 0:
+        # The coordinator re-binds its previous effective port (it may have
+        # been chosen ephemerally at first start); workers re-connect to
+        # the same well-known address they always used.  Only the LISTEN
+        # socket is released now: the old engine's peer sockets must stay
+        # open through the re-rendezvous, or a survivor that has not yet
+        # read the RECONFIG broadcast gets RST and its receive queue —
+        # verdict included — is flushed (it would misread the shrink as
+        # coordinator death).
+        ctor["coordinator_port"] = eng.bound_port
+        eng.detach_listener()
+    else:
+        eng.shutdown()
+    # The verifier's rolling hash restarts with the new membership (the
+    # native coordinator's streams are rebuilt from scratch).
+    from horovod_tpu.analysis import schedule as _schedule
+
+    _schedule.recorder().reset()
+    # Bound the re-rendezvous by the reconfiguration budget, not the
+    # generous first-boot connect budget: survivors are already running, so
+    # a peer that cannot re-form in time means the membership changed again
+    # — fall back to the full-restart path quickly.
+    prev_budget = os.environ.get("HVD_TPU_CONNECT_TIMEOUT")
+    os.environ["HVD_TPU_CONNECT_TIMEOUT"] = str(
+        max(env.reconfig_timeout_ms() / 1000.0, 1.0))
+    try:
+        new_eng = _engine_mod.NativeEngine(
+            ev.new_rank, ev.new_size, epoch=ev.epoch, **ctor)
+    finally:
+        if prev_budget is None:
+            os.environ.pop("HVD_TPU_CONNECT_TIMEOUT", None)
+        else:
+            os.environ["HVD_TPU_CONNECT_TIMEOUT"] = prev_budget
+        if ev.new_rank == 0:
+            # Every survivor is wired into the new membership (or the
+            # rendezvous failed and this process is going down): the old
+            # engine and its absorbed peer sockets can finally go.
+            eng.shutdown()
+    _engine_mod.replace_engine(eng, new_eng)
+    from horovod_tpu import basics as _basics
+
+    _basics._apply_resize(ev.new_rank, ev.new_size)
+    _last_event = ev
+    for cb in _callbacks:
+        cb(ev)
+    return ev
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise OSError("connection closed mid-frame")
+        buf += chunk
+    return buf
+
+
+def join(host: str, port: int, *, old_rank: int = -1,
+         timeout_s: float | None = None) -> JoinTicket:
+    """Rejoin a running elastic job: the relaunched rank's side of the
+    ``JOIN``/``JOIN_ACK`` handshake (``python -m horovod_tpu.run --elastic``
+    sets ``HVD_TPU_ELASTIC_JOIN=1`` on single-rank relaunches to request
+    it).  Knocks on the coordinator's control-plane listen socket with a
+    hardened JOIN frame and retries — through shrink re-rendezvous windows
+    where the socket is down or busy — until the coordinator's monitor
+    thread admits it at the next reconfiguration boundary.
+
+    Returns the :class:`JoinTicket` naming the epoch, size, and rank to
+    rendezvous with; create the engine from it and restore from the last
+    complete checkpoint like any other member.  Bounded by ``timeout_s``
+    (default: the rendezvous budget, ``HVD_TPU_CONNECT_TIMEOUT``)."""
+    budget = timeout_s
+    if budget is None:
+        budget = float(os.environ.get("HVD_TPU_CONNECT_TIMEOUT", "300") or 300)
+    deadline = time.monotonic() + budget
+    delay = 0.05
+    last_err: Exception | None = None
+    while time.monotonic() < deadline:
+        sock = None
+        try:
+            sock = socket.create_connection((host, port), timeout=2.0)
+            payload = struct.pack("<i", old_rank)
+            sock.sendall(struct.pack(
+                "<IBBHII", _FRAME_MAGIC, _WIRE_VERSION, _FRAME_JOIN, 0,
+                len(payload), zlib.crc32(payload)) + payload)
+            sock.settimeout(5.0)
+            hdr = _recv_exact(sock, 16)
+            magic, _ver, ftype, _flags, plen, crc = struct.unpack(
+                "<IBBHII", hdr)
+            if magic != _FRAME_MAGIC or ftype != _FRAME_JOIN_ACK:
+                raise OSError(f"unexpected frame type {ftype} awaiting "
+                              f"JOIN_ACK")
+            body = _recv_exact(sock, plen)
+            if zlib.crc32(body) != crc:
+                raise OSError("JOIN_ACK CRC mismatch")
+            epoch, new_size, assigned = struct.unpack_from("<qii", body)
+            return JoinTicket(epoch, new_size, assigned)
+        except OSError as exc:
+            last_err = exc
+            time.sleep(min(delay, max(deadline - time.monotonic(), 0)))
+            delay = min(delay * 2, 1.0)
+        finally:
+            if sock is not None:
+                sock.close()
+    raise TimeoutError(
+        f"could not rejoin the job at {host}:{port} within {budget:.0f}s "
+        f"(last error: {last_err}); is the coordinator running with "
+        f"HVD_TPU_ELASTIC=1?")

@@ -23,17 +23,22 @@ Injectors (all opt-in; absent env == no faults):
   rank 0 overwrites part of its payload with garbage (bit-rot / torn
   upload); proves restore falls back to the previous complete step.
 * ``HVD_TPU_FAULT_WIRE_{DROP,CORRUPT,PARTITION,HALFCLOSE}`` =
-  ``"<rank>[:<frame>]"`` — wire-level chaos against the TCP control plane
-  (executed natively in core/src/controller.cc; parsed here too so
-  :func:`armed` and tests see one plan).  From its ``<frame>``-th sent
-  control-plane frame on, the named rank DROPs every outgoing frame
+  ``"<rank>[:<frame>][@<epoch>]"`` — wire-level chaos against the TCP
+  control plane (executed natively in core/src/controller.cc; parsed here
+  too so :func:`armed` and tests see one plan).  From its ``<frame>``-th
+  sent control-plane frame on, the named rank DROPs every outgoing frame
   (one-way partition), CORRUPTs one frame's payload after the CRC is
   computed (the receiver must reject it, never deserialize garbage),
   PARTITIONs fully (sends dropped and receives ignored), or HALFCLOSEs
   its write side (peers see EOF mid-stream while it keeps reading).
-  Every scenario must end in a structured ``hvd.failure_report()`` abort
-  within the heartbeat bound — never a hang (tests/test_failure_detection.py
-  chaos soak).
+  The optional ``@<epoch>`` keys the plan to one membership epoch
+  (default 0): an elastic job (``HVD_TPU_ELASTIC=1``) that shrinks past
+  the fault re-forms its control plane at the next epoch and runs clean,
+  exactly like ``HVD_TPU_RESTART_ATTEMPT`` keys process-level injectors
+  to one launch attempt.  Every scenario must end in success, a clean
+  shrink, or a structured ``hvd.failure_report()`` abort within the
+  heartbeat bound — never a hang (tests/test_failure_detection.py
+  chaos soaks).
 * ``HVD_TPU_FAULT_ON_ATTEMPT`` (default 0) — faults fire only when the
   launcher-exported ``HVD_TPU_RESTART_ATTEMPT`` matches, so an injected
   crash consumes exactly one restart and the relaunched job runs clean.
@@ -61,9 +66,11 @@ import time
 class FaultPlan:
     """Parsed injector configuration (None field == injector disabled).
 
-    The ``wire_*`` injectors are ``(rank, frame)`` tuples executed by the
-    native control plane (core/src/controller.cc reads the same env);
-    they appear here so ``armed()``/tooling see the whole plan.
+    The ``wire_*`` injectors are ``(rank, frame, epoch)`` tuples executed
+    by the native control plane (core/src/controller.cc reads the same
+    env); they appear here so ``armed()``/tooling see the whole plan.
+    ``epoch`` keys the plan to one membership epoch (elastic resize bumps
+    the epoch, disarming epoch-0 plans after a shrink).
     """
 
     kill_rank: int | None = None
@@ -75,10 +82,10 @@ class FaultPlan:
     delay_step: int | None = None
     delay_ms: float = 500.0
     corrupt_step: int | None = None
-    wire_drop: tuple[int, int] | None = None
-    wire_corrupt: tuple[int, int] | None = None
-    wire_partition: tuple[int, int] | None = None
-    wire_halfclose: tuple[int, int] | None = None
+    wire_drop: tuple[int, int, int] | None = None
+    wire_corrupt: tuple[int, int, int] | None = None
+    wire_partition: tuple[int, int, int] | None = None
+    wire_halfclose: tuple[int, int, int] | None = None
     on_attempt: int = 0
 
     def any_active(self) -> bool:
@@ -95,14 +102,16 @@ def _int_env(name: str) -> int | None:
     return int(raw)
 
 
-def _wire_env(name: str) -> tuple[int, int] | None:
-    """Parse a wire injector's ``"<rank>[:<frame>]"`` value (frame 0 when
-    omitted) — the format core/src/controller.cc ParseWireFaultEnv reads."""
+def _wire_env(name: str) -> tuple[int, int, int] | None:
+    """Parse a wire injector's ``"<rank>[:<frame>][@<epoch>]"`` value
+    (frame and epoch 0 when omitted) — the grammar
+    core/src/controller.cc ParseWireFaultEnv reads."""
     raw = os.environ.get(name)
     if raw is None or raw == "":
         return None
+    raw, _, epoch_s = raw.partition("@")
     rank_s, _, frame_s = raw.partition(":")
-    return int(rank_s), int(frame_s or 0)
+    return int(rank_s), int(frame_s or 0), int(epoch_s or 0)
 
 
 def _plan_from_env() -> FaultPlan:

@@ -86,7 +86,7 @@ def _pump(stream, rank: int, tag: bool, lock: threading.Lock) -> None:
 
 def _child_env(rank: int, np_: int, jax_port: int, coord_port: int,
                platform: str | None, attempt: int,
-               resume_dir: str | None) -> dict[str, str]:
+               resume_dir: str | None, join: bool = False) -> dict[str, str]:
     env = dict(os.environ)
     env["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{jax_port}"
     env["JAX_NUM_PROCESSES"] = str(np_)
@@ -95,6 +95,13 @@ def _child_env(rank: int, np_: int, jax_port: int, coord_port: int,
     env["HVD_TPU_COORDINATOR_PORT"] = str(coord_port)
     env.setdefault("HVD_TPU_EXECUTOR", "multihost")
     env["HVD_TPU_RESTART_ATTEMPT"] = str(attempt)
+    if join:
+        # Single-rank elastic relaunch: the child must JOIN the surviving
+        # job (elastic.join) instead of rendezvousing as a founding member
+        # (docs/fault_tolerance.md "In-place recovery").
+        env["HVD_TPU_ELASTIC_JOIN"] = "1"
+    else:
+        env.pop("HVD_TPU_ELASTIC_JOIN", None)
     if resume_dir is not None:
         env["HVD_TPU_RESUME_DIR"] = resume_dir
     else:
@@ -146,8 +153,20 @@ class _StopRequest:
 
 def _run_once(command: list[str], args, attempt: int,
               resume_dir: str | None, stop: _StopRequest,
-              lock: threading.Lock) -> int:
-    """Launch all ranks once; return the job's exit code (0 = clean)."""
+              lock: threading.Lock, stats: dict | None = None) -> int:
+    """Launch all ranks once; return the job's exit code (0 = clean).
+
+    In elastic mode (``--elastic`` / ``HVD_TPU_ELASTIC=1``,
+    docs/fault_tolerance.md "In-place recovery") an abnormal exit from a
+    NON-coordinator rank while rank 0 survives does not abort the job:
+    only that rank is relaunched (with ``HVD_TPU_ELASTIC_JOIN=1``, so it
+    rejoins via JOIN) — the survivors shrank in place and keep training.
+    Single-rank relaunches are accounted in ``stats`` separately from
+    full-job restarts; a relaunched rank that later exits cleanly marks
+    ``rejoin_success`` so the supervisor's crash-loop breaker resets.
+    Rank-0 death keeps the mpirun job-abort contract (coordinator
+    failover is out of scope)."""
+    stats = stats if stats is not None else {}
     jax_port, coord_port = _free_port(), _free_port()
     procs: list[subprocess.Popen] = []
     pumps: list[threading.Thread] = []
@@ -178,6 +197,15 @@ def _run_once(command: list[str], args, attempt: int,
     exit_code = 0
     remaining = set(range(args.np_))
     drain_deadline: float | None = None
+    # Elastic single-rank relaunch state (see docstring).
+    elastic = bool(getattr(args, "elastic", False))
+    relaunch_counts: dict[int, int] = {}
+    relaunched: set[int] = set()
+    relaunch_backoff = Backoff(
+        initial_s=float(os.environ.get("HVD_TPU_RESTART_BACKOFF", "1.0")
+                        or 1.0),
+        max_s=max(30.0, float(os.environ.get("HVD_TPU_RESTART_BACKOFF",
+                                             "1.0") or 1.0)))
     try:
         while remaining:
             if stop.event.is_set() and drain_deadline is None:
@@ -205,6 +233,62 @@ def _run_once(command: list[str], args, attempt: int,
                 rc = procs[r].returncode
                 if rc < 0:  # killed by signal: report as 128+signum
                     rc = 128 - rc
+                if rc == 0 and r in relaunched:
+                    # The rejoin worked end to end: the relaunched rank ran
+                    # to clean completion.  The supervisor's crash-loop
+                    # breaker resets on this (main()).
+                    stats["rejoin_success"] = True
+                if rc != 0 and elastic and r != 0 and 0 in remaining \
+                        and not stop.event.is_set() and exit_code == 0:
+                    # Elastic grow path: survivors shrank in place; bring
+                    # ONLY this rank back and let it JOIN.  Per-rank cap so
+                    # a rank that can never rejoin still aborts the job.
+                    spent = relaunch_counts.get(r, 0)
+                    if spent < max(args.max_restarts, 1):
+                        delay = relaunch_backoff.delay(spent)
+                        with lock:
+                            sys.stderr.write(
+                                f"horovod_tpu.run: rank {r} exited with "
+                                f"code {rc}; elastic mode: relaunching only "
+                                f"rank {r} to rejoin in {delay:.2f}s "
+                                f"(single-rank relaunch {spent + 1})\n")
+                        if stop.event.wait(timeout=delay):
+                            # Drain requested mid-backoff: no relaunch, but
+                            # the abnormal exit still counts as the job's.
+                            if exit_code == 0:
+                                exit_code = rc
+                            continue
+                        relaunch_counts[r] = spent + 1
+                        stats["single_rank_relaunches"] = (
+                            stats.get("single_rank_relaunches", 0) + 1)
+                        # The relaunched rank's injectors key off a fresh
+                        # attempt counter, so the fault that killed it does
+                        # not re-fire in the rejoined incarnation.
+                        p = subprocess.Popen(
+                            command,
+                            env=_child_env(r, args.np_, jax_port, coord_port,
+                                           args.platform or None,
+                                           attempt + relaunch_counts[r],
+                                           resume_dir, join=True),
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT,
+                            start_new_session=True)
+                        procs[r] = p
+                        _current_procs[:] = procs
+                        t = threading.Thread(
+                            target=_pump,
+                            args=(p.stdout, r, not args.no_tag_output, lock),
+                            daemon=True)
+                        t.start()
+                        pumps.append(t)
+                        remaining.add(r)
+                        relaunched.add(r)
+                        continue
+                    with lock:
+                        sys.stderr.write(
+                            f"horovod_tpu.run: rank {r} exhausted its "
+                            f"single-rank relaunch budget; falling back to "
+                            f"a full-job restart\n")
                 if rc != 0 and exit_code == 0:
                     exit_code = rc
                     if not stop.event.is_set():
@@ -276,6 +360,14 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--drain-secs", type=float, default=30.0,
                         help="grace between forwarding SIGTERM to ranks and "
                              "SIGKILL escalation (default 30)")
+    parser.add_argument("--elastic", action="store_true",
+                        help="in-place elastic recovery (implied by "
+                             "HVD_TPU_ELASTIC=1): a dead non-coordinator "
+                             "rank is relaunched ALONE with "
+                             "HVD_TPU_ELASTIC_JOIN=1 and rejoins the "
+                             "surviving, still-running job; rank-0 death "
+                             "keeps the full-restart path "
+                             "(docs/fault_tolerance.md)")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="program and arguments (e.g. python train.py)")
     args = parser.parse_args(argv)
@@ -293,6 +385,13 @@ def main(argv: list[str] | None = None) -> int:
         command = command[1:]
     if not command:
         parser.error("no command given (e.g. ... -np 2 python train.py)")
+    if os.environ.get("HVD_TPU_ELASTIC", "") not in ("", "0", "false",
+                                                     "False"):
+        args.elastic = True
+    if args.elastic:
+        # Children read HVD_TPU_ELASTIC natively (core/src/c_api.cc): the
+        # flag and the env spelling must agree.
+        os.environ["HVD_TPU_ELASTIC"] = "1"
 
     lock = threading.Lock()
     stop = _StopRequest()
@@ -316,6 +415,20 @@ def main(argv: list[str] | None = None) -> int:
 
     attempt = 0
     spent_restarts = 0
+    total_single_relaunches = 0
+
+    def _finish(code: int) -> int:
+        # Supervisor summary: full-job restarts and single-rank (elastic
+        # rejoin) relaunches are accounted separately — an elastic job that
+        # shrinks and regrows for hours should read as "N rejoins", not as
+        # a crash loop.
+        with lock:
+            sys.stderr.write(
+                f"horovod_tpu.run: supervisor summary: full_restarts="
+                f"{attempt} single_rank_relaunches="
+                f"{total_single_relaunches}\n")
+        return code
+
     while True:
         resume_dir = None
         if args.ckpt_dir:
@@ -329,16 +442,23 @@ def main(argv: list[str] | None = None) -> int:
                     + (f"from checkpoint {resume_dir}\n" if resume_dir
                        else "from scratch (no complete checkpoint)\n"))
         started = time.monotonic()
-        exit_code = _run_once(command, args, attempt, resume_dir, stop, lock)
+        stats: dict = {}
+        exit_code = _run_once(command, args, attempt, resume_dir, stop, lock,
+                              stats)
         ran_s = time.monotonic() - started
+        total_single_relaunches += stats.get("single_rank_relaunches", 0)
         if stop.event.is_set():
             # Drained on request: the children's own exit codes tell whether
             # the checkpoint landed (0 = clean drain).  Never restart.
-            return exit_code
+            return _finish(exit_code)
         if exit_code == 0:
-            return 0
-        if ran_s >= args.restart_window:
-            spent_restarts = 0  # healthy run before the failure: earn back
+            return _finish(0)
+        if ran_s >= args.restart_window or stats.get("rejoin_success"):
+            # Healthy run before the failure — or a proven in-place rejoin
+            # — earns the jittered-backoff/crash-loop-breaker state back:
+            # an elastic job that shrinks and regrows for hours must not
+            # eventually be killed by a budget meant for crash loops.
+            spent_restarts = 0
         if spent_restarts >= args.max_restarts:
             if args.max_restarts > 0:
                 with lock:
@@ -346,7 +466,7 @@ def main(argv: list[str] | None = None) -> int:
                         f"horovod_tpu.run: restart budget exhausted "
                         f"({args.max_restarts} within {args.restart_window:g}"
                         f"s); giving up with exit code {exit_code}\n")
-            return exit_code
+            return _finish(exit_code)
         delay = backoff.delay(spent_restarts)
         spent_restarts += 1
         attempt += 1
@@ -359,7 +479,7 @@ def main(argv: list[str] | None = None) -> int:
         # Interruptible backoff: a drain request during the sleep exits
         # immediately instead of launching another attempt.
         if stop.event.wait(timeout=delay):
-            return exit_code
+            return _finish(exit_code)
 
 
 if __name__ == "__main__":

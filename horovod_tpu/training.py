@@ -329,12 +329,25 @@ def elastic_loop(step_fn, state, *, num_steps: int, manager=None,
     :class:`~horovod_tpu.checkpoint.ElasticCheckpoint`) lets the caller
     re-seat rng/data-iterator position from the resume metadata.
 
+    Under ``HVD_TPU_ELASTIC=1`` (docs/fault_tolerance.md "In-place
+    recovery") a :class:`~horovod_tpu.core.engine.MembershipChanged`
+    signal from a step — a peer died and the survivors shrank, or a
+    relaunched rank rejoined — is recovered WITHOUT leaving this process:
+    the loop calls ``elastic.reconfigure()`` (re-forming the engine under
+    the new membership and firing ``on_reconfigure`` callbacks, where LR
+    re-scaling and data re-sharding belong), restores from the last
+    complete checkpoint, and continues from the step after it; with no
+    manager, the aborted step simply replays.  Without elastic mode the
+    signal propagates like any failure and the launcher's full-restart
+    supervision takes over.
+
     Returns the final state.
     """
     import sys as _sys
 
     from horovod_tpu import checkpoint as _checkpoint
     from horovod_tpu import faults as _faults
+    from horovod_tpu.core.engine import MembershipChanged as _Resized
 
     start_step = 0
     if manager is not None:
@@ -359,12 +372,37 @@ def elastic_loop(step_fn, state, *, num_steps: int, manager=None,
         manager.drain()
         _sys.exit(0)
 
-    for step in range(start_step, num_steps):
+    step = start_step
+    while step < num_steps:
         if manager is not None and _checkpoint.preemption_requested():
             _drain_exit(step - 1, state)
         _faults.step(step)
         try:
             state = step_fn(step, state)
+        except _Resized:
+            from horovod_tpu import elastic as _elastic
+
+            if not _elastic.enabled():
+                raise
+            # In-place recovery: re-form the engine under the new
+            # membership (same process), then resume from the last
+            # complete checkpoint so every surviving rank — and any
+            # joiner restoring at its own loop entry — re-enters the
+            # step sequence at the same point with matching collective
+            # names.  reconfigure() raises when WE were the rank removed
+            # (the engine's restartable exit is already scheduled).
+            _elastic.reconfigure()
+            if manager is not None:
+                ckpt = manager.restore_latest(template=state)
+                if ckpt is not None:
+                    state = ckpt.state
+                    step = ckpt.step + 1
+                    if on_resume is not None:
+                        on_resume(ckpt)
+                    continue
+            # No checkpoint to rewind to: the failed step's collectives
+            # were aborted before completing, so replaying it is safe.
+            continue
         except Exception:
             # A peer that drained on the same preemption signal tears the
             # collectives down under us (coordinated engine shutdown);
@@ -381,6 +419,7 @@ def elastic_loop(step_fn, state, *, num_steps: int, manager=None,
             if (step + 1) % max(checkpoint_every, 1) == 0 \
                     or step == num_steps - 1:
                 manager.save(step, state, metadata=_metadata(step))
+        step += 1
     if manager is not None:
         manager.drain()
     return state

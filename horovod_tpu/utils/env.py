@@ -55,9 +55,22 @@ aliases; the TPU-specific defaults differ where the hardware does:
 * ``HVD_TPU_WIRE_VERSION`` — testing override of the advertised hardened-
   frame protocol version (core/src/message.h); mismatched peers are
   rejected at the connect handshake with a structured version-skew error.
+* ``HVD_TPU_ELASTIC`` — in-place elastic recovery (default off): a dead
+  non-coordinator rank triggers a coordinated RECONFIG shrink (survivors
+  re-form the engine in the same process) instead of the full
+  abort-and-restart; the launcher's ``--elastic`` mode relaunches only the
+  dead rank, which rejoins via JOIN (docs/fault_tolerance.md "In-place
+  recovery").
+* ``HVD_TPU_MIN_SIZE`` — survivor-count floor (default 1) below which an
+  elastic job falls back to the legacy exit-75 full restart.
+* ``HVD_TPU_RECONFIG_TIMEOUT_MS`` — bound (default 30000) on in-place
+  reconfiguration (resize acknowledgement + re-rendezvous); expiry falls
+  back to abort-and-restart, keeping the nothing-blocks-forever guarantee.
 * ``HVD_TPU_FAULT_*`` — deterministic fault injection (faults.py),
   including the wire-level chaos injectors
-  ``HVD_TPU_FAULT_WIRE_{DROP,CORRUPT,PARTITION,HALFCLOSE}="<rank>[:<frame>]"``.
+  ``HVD_TPU_FAULT_WIRE_{DROP,CORRUPT,PARTITION,HALFCLOSE}`` =
+  ``"<rank>[:<frame>][@<epoch>]"`` (the ``@<epoch>`` suffix keys a plan to
+  one membership epoch so an elastic shrink past the fault runs clean).
 """
 
 from __future__ import annotations
@@ -181,6 +194,41 @@ def verify_interval_ticks() -> int:
     5 ms cycle, cheap enough to leave on for whole debug runs)."""
     raw = _get("VERIFY_INTERVAL_TICKS")
     return int(raw) if raw else DEFAULT_VERIFY_INTERVAL_TICKS
+
+
+DEFAULT_MIN_SIZE = 1
+DEFAULT_RECONFIG_TIMEOUT_MS = 30000.0
+
+
+def elastic_enabled() -> bool:
+    """``HVD_TPU_ELASTIC`` — in-place elastic recovery
+    (docs/fault_tolerance.md "In-place recovery"): when a non-coordinator
+    rank dies, survivors shrink to the new membership in the same process
+    (RECONFIG broadcast + engine re-form) instead of exiting 75 for a full
+    relaunch; the launcher's ``--elastic`` mode relaunches only the dead
+    rank, which rejoins via JOIN.  Coordinator death and shrinks below
+    ``HVD_TPU_MIN_SIZE`` keep the full-restart path.  Read natively in
+    core/src/c_api.cc."""
+    raw = _get("ELASTIC")
+    return bool(raw) and raw not in ("0", "false", "False")
+
+
+def min_size() -> int:
+    """``HVD_TPU_MIN_SIZE`` — the survivor-count floor (default 1) below
+    which an elastic job stops shrinking and falls back to the legacy
+    abort-and-restart path (exit 75)."""
+    raw = _get("MIN_SIZE")
+    return int(raw) if raw not in (None, "") else DEFAULT_MIN_SIZE
+
+
+def reconfig_timeout_ms() -> float:
+    """``HVD_TPU_RECONFIG_TIMEOUT_MS`` — bound (default 30000) on the
+    whole in-place reconfiguration: an unacknowledged resize event, or a
+    re-rendezvous that cannot complete within it, falls back to
+    abort-and-restart so nothing blocks forever (the PR-4 guarantee)."""
+    raw = _get("RECONFIG_TIMEOUT_MS")
+    return float(raw) if raw not in (None, "") \
+        else DEFAULT_RECONFIG_TIMEOUT_MS
 
 
 DEFAULT_OVERLAP_BUCKETS = 4

@@ -1,0 +1,492 @@
+"""In-place elastic recovery: shrink-to-survive membership reconfiguration
+and rank rejoin (docs/fault_tolerance.md "In-place recovery").
+
+PR 4 made peer-death *detection* ~100 ms; these tests cover the *recovery*
+half: with ``HVD_TPU_ELASTIC=1`` the survivors of a non-coordinator death
+shrink in place — RECONFIG broadcast, epoch bump, same-process engine
+re-form — instead of exiting 75 for a full relaunch.  Children are
+engine-only where possible (numpy + ctypes) so scenarios stay cheap; the
+checkpoint-resume test pays the jax import because it drives the REAL
+``training.elastic_loop`` + ``CheckpointManager`` path.
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from _timing import scaled
+from _tsan import tsan_runtime
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+FAST_HB = {
+    "HVD_TPU_HEARTBEAT_MS": "50",
+    "HVD_TPU_HEARTBEAT_TIMEOUT_MS": str(int(scaled(800))),
+    "HVD_TPU_ABORT_GRACE_MS": "300",
+    "HVD_TPU_CONNECT_TIMEOUT": str(scaled(60)),
+    "HVD_TPU_RECONFIG_TIMEOUT_MS": str(int(scaled(20000))),
+    "HVD_TPU_ELASTIC": "1",
+}
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn(script, nprocs, extra_env, port=None, args=()):
+    port = port or _free_port()
+    env = {**os.environ, "PYTHONPATH": REPO, **FAST_HB, **extra_env}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", script, str(r), str(port), str(nprocs),
+             *[str(a) for a in args]],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=REPO)
+        for r in range(nprocs)
+    ]
+    return procs, port
+
+
+def _drain(procs, timeout):
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out or "")
+    return outs
+
+
+# Engine-only elastic worker: streams allreduces; on MembershipChanged it
+# reconfigures in place and resynchronizes its name counter through the
+# shared epoch (real training resynchronizes through the checkpoint step —
+# see the elastic_loop test below).  argv: rank port nprocs [total]
+ELASTIC_WORKER = textwrap.dedent("""
+    import os, sys, time
+    import numpy as np
+    from horovod_tpu.core.engine import NativeEngine, OP_ALLREDUCE, \\
+        CollectiveError, MembershipChanged
+    from horovod_tpu.core import engine as em
+    from horovod_tpu.core.executors import local_executor
+    from horovod_tpu import elastic
+
+    rank, port, n = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    total = int(sys.argv[4]) if len(sys.argv) > 4 else 30
+    eng = NativeEngine(rank, n, executor=local_executor,
+                       coordinator_host="127.0.0.1", coordinator_port=port,
+                       cycle_time_ms=2.0)
+    elastic.attach(eng)
+    pid = os.getpid()
+    i, done = 0, 0
+    while done < total:
+        try:
+            h = eng.enqueue(f"s{i}", np.ones(8, np.float32), OP_ALLREDUCE)
+            eng.synchronize(h, timeout_s=120.0)
+            done += 1
+            i += 1
+            if done == 5:
+                print(f"RANK{rank} STEADY pid={pid}", flush=True)
+        except MembershipChanged:
+            ev = elastic.reconfigure()
+            eng = em.peek_engine()
+            i = ev.epoch * 1000
+            print(f"RANK{rank} RECONFIGURED epoch={ev.epoch} "
+                  f"new_rank={ev.new_rank} new_size={ev.new_size} "
+                  f"failed={ev.failed_rank} pid={os.getpid()}", flush=True)
+        except CollectiveError as e:
+            print(f"RANK{rank} ABORTED {e}", flush=True)
+            time.sleep(30)  # the abort grace exits 75
+            sys.exit(3)
+    print(f"RANK{rank} DONE rank={eng.rank} size={eng.size} "
+          f"epoch={eng.epoch} pid={os.getpid()}", flush=True)
+    eng.shutdown()
+""")
+
+
+def _wait_steady(proc, deadline):
+    lines = []
+    for line in proc.stdout:
+        lines.append(line)
+        if "STEADY" in line:
+            return lines
+        assert time.monotonic() < deadline, "".join(lines[-30:])
+    raise AssertionError("stream ended early:\n" + "".join(lines[-30:]))
+
+
+def test_shrink_in_place_reassigns_ranks_no_process_restart():
+    """Kill the MIDDLE rank of 3: survivors shrink to size 2 with
+    contiguous re-assigned ranks (old rank 2 -> new rank 1), the epoch
+    bumps to 1, collectives resume, and — the point of the PR — both
+    survivors finish in the SAME process (pid unchanged, exit 0)."""
+    procs, _ = _spawn(ELASTIC_WORKER, 3, {})
+    try:
+        deadline = time.monotonic() + scaled(60)
+        heads = [_wait_steady(p, deadline) for p in procs]
+        procs[1].kill()
+        outs = _drain(procs, timeout=scaled(60))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    full = ["".join(h) + o for h, o in zip(heads, outs)]
+    assert procs[0].returncode == 0, (procs[0].returncode, full[0][-2000:])
+    assert procs[2].returncode == 0, (procs[2].returncode, full[2][-2000:])
+    # Rank 0 stays rank 0; old rank 2 is contiguously re-assigned rank 1.
+    assert "RANK0 RECONFIGURED epoch=1 new_rank=0 new_size=2 failed=1" \
+        in full[0], full[0][-2000:]
+    assert "RANK2 RECONFIGURED epoch=1 new_rank=1 new_size=2 failed=1" \
+        in full[2], full[2][-2000:]
+    assert "RANK0 DONE rank=0 size=2 epoch=1" in full[0], full[0][-2000:]
+    assert "RANK2 DONE rank=1 size=2 epoch=1" in full[2], full[2][-2000:]
+    # No process restart: the pid before the kill equals the pid after.
+    for r in (0, 2):
+        pre = full[r].split("STEADY pid=", 1)[1].split()[0]
+        post = full[r].split("DONE", 1)[1].split("pid=", 1)[1].split()[0]
+        assert pre == post, (r, pre, post)
+
+
+def test_min_size_floor_keeps_legacy_full_restart_path():
+    """HVD_TPU_MIN_SIZE=2 with 2 processes: the shrink to 1 would cross
+    the floor, so the legacy coordinated abort applies — survivor exits 75
+    with a failure report naming the dead rank, and no RECONFIG fires."""
+    procs, _ = _spawn(ELASTIC_WORKER, 2, {"HVD_TPU_MIN_SIZE": "2"})
+    try:
+        deadline = time.monotonic() + scaled(60)
+        heads = [_wait_steady(p, deadline) for p in procs]
+        procs[1].kill()
+        outs = _drain(procs, timeout=scaled(60))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    full = "".join(heads[0]) + outs[0]
+    assert procs[0].returncode == 75, (procs[0].returncode, full[-2000:])
+    assert "RECONFIGURED" not in full, full[-2000:]
+    assert "ABORTED" in full, full[-2000:]
+
+
+# The REAL recovery path: training.elastic_loop + CheckpointManager.
+# argv: rank port nprocs ckpt_dir steps
+ELASTIC_TRAIN = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    from horovod_tpu.core.engine import NativeEngine, OP_ALLREDUCE
+    from horovod_tpu.core import engine as em
+    from horovod_tpu.core.executors import local_executor
+    from horovod_tpu import checkpoint, elastic, training
+
+    rank, port, n = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    ckpt_dir, steps = sys.argv[4], int(sys.argv[5])
+    eng = NativeEngine(rank, n, executor=local_executor,
+                       coordinator_host="127.0.0.1", coordinator_port=port,
+                       cycle_time_ms=2.0)
+    elastic.attach(eng)
+    pid = os.getpid()
+    # rank= gates writes to the actual rank 0; size=1 restores from the
+    # shared directory directly (engine-only job: no broadcast plane).
+    mgr = checkpoint.CheckpointManager(ckpt_dir, max_to_keep=2, rank=rank,
+                                       size=1)
+
+    def step_fn(step, state):
+        e = em.peek_engine()   # the engine can be re-formed between steps
+        grad = np.full(4, float(step + 1), np.float32)
+        h = e.enqueue(f"el.g{step}", grad, OP_ALLREDUCE)
+        g = e.synchronize(h, timeout_s=120.0)
+        print(f"STEP {step} rank={rank}", flush=True)
+        return {"params": state["params"] + g}
+
+    state = {"params": np.zeros(4, np.float32)}
+    state = training.elastic_loop(step_fn, state, num_steps=steps,
+                                  manager=mgr, checkpoint_every=1)
+    print(f"[rank {rank}] FINAL={state['params'].tolist()} pid={pid} "
+          f"now={os.getpid()} size={em.peek_engine().size}", flush=True)
+    em.peek_engine().shutdown()  # coordinated teardown, no EOF-side effects
+""")
+
+
+def _finals(outs):
+    res = {}
+    for out in outs:
+        for line in out.splitlines():
+            if "FINAL=" in line:
+                r = int(line.split("[rank ", 1)[1].split("]")[0])
+                res[r] = line.split("FINAL=", 1)[1].split(" pid=")[0]
+    return res
+
+
+def test_elastic_loop_shrinks_and_resumes_bit_exact_from_checkpoint(
+        tmp_path):
+    """The acceptance scenario: 3 ranks in training.elastic_loop with
+    manifest-committed checkpoints; rank 2 is SIGKILLed at step 3.  The
+    survivors shrink to size 2 and resume from the step-2 checkpoint
+    WITHOUT process restart — final parameters are bit-identical to an
+    uninterrupted run's, and each survivor's pid is unchanged."""
+    steps = 6
+    expected = str([float(sum(s + 1 for s in range(steps)))] * 4)
+
+    def run(tag, extra_env, kill=False):
+        ckpt = tmp_path / tag
+        ckpt.mkdir()
+        env = {**extra_env}
+        procs, _ = _spawn(ELASTIC_TRAIN, 3, env,
+                          args=(ckpt, steps))
+        outs = _drain(procs, timeout=scaled(240))
+        return procs, outs
+
+    # Uninterrupted reference run.
+    clean_procs, clean_outs = run("clean", {})
+    assert all(p.returncode == 0 for p in clean_procs), \
+        [o[-1500:] for o in clean_outs]
+    clean_finals = _finals(clean_outs)
+    assert set(clean_finals) == {0, 1, 2}
+    assert clean_finals[0] == expected, clean_finals
+
+    # Faulted run: deterministic SIGKILL of rank 2 at step 3 (faults.py,
+    # rank from JAX_PROCESS_ID in each child).
+    ckpt = tmp_path / "faulted"
+    ckpt.mkdir()
+    port = _free_port()
+    procs = []
+    for r in range(3):
+        env = {**os.environ, "PYTHONPATH": REPO, **FAST_HB,
+               "JAX_PROCESS_ID": str(r),
+               "HVD_TPU_FAULT_KILL_RANK": "2",
+               "HVD_TPU_FAULT_KILL_STEP": "3"}
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", ELASTIC_TRAIN, str(r), str(port), "3",
+             str(ckpt), str(steps)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=REPO))
+    outs = _drain(procs, timeout=scaled(240))
+    assert procs[0].returncode == 0, outs[0][-2500:]
+    assert procs[1].returncode == 0, outs[1][-2500:]
+    assert procs[2].returncode != 0  # the killed rank
+    finals = _finals(outs)
+    assert set(finals) == {0, 1}, outs[0][-1500:]
+    # Bit-identical to the uninterrupted run.
+    assert finals[0] == expected, (finals, expected)
+    assert finals[1] == expected
+    # In place: same pid before and after, shrunken engine size 2.
+    for r in (0, 1):
+        line = [ln for ln in outs[r].splitlines() if "FINAL=" in ln][0]
+        pid = line.split("pid=", 1)[1].split()[0]
+        now = line.split("now=", 1)[1].split()[0]
+        assert pid == now, line
+        assert "size=2" in line, line
+    # The job genuinely rewound to the checkpoint: the pre-kill step-3
+    # attempt aborted (no completion print), and step 3 completed exactly
+    # once, AFTER the reconfiguration notice.
+    assert outs[0].count("STEP 3 rank=0") == 1, outs[0][-2500:]
+    assert outs[0].index("Membership changed") \
+        < outs[0].index("STEP 3 rank=0"), outs[0][-2500:]
+
+
+# Rejoin end to end through the launcher: engine-only children, injected
+# SIGKILL, single-rank relaunch with HVD_TPU_ELASTIC_JOIN=1.
+LAUNCHED_ELASTIC = textwrap.dedent("""
+    import os, sys, time
+    import numpy as np
+    from horovod_tpu.core.engine import NativeEngine, OP_ALLREDUCE, \\
+        CollectiveError, MembershipChanged
+    from horovod_tpu.core import engine as em
+    from horovod_tpu.core.executors import local_executor
+    from horovod_tpu import elastic, faults
+
+    rank = int(os.environ["JAX_PROCESS_ID"])
+    n = int(os.environ["JAX_NUM_PROCESSES"])
+    port = int(os.environ["HVD_TPU_COORDINATOR_PORT"])
+    if os.environ.get("HVD_TPU_ELASTIC_JOIN") == "1":
+        t = elastic.join("127.0.0.1", port, old_rank=rank,
+                         timeout_s=float(os.environ.get(
+                             "HVD_TPU_CONNECT_TIMEOUT", "60")))
+        print(f"RANK{rank} TICKET epoch={t.epoch} size={t.new_size} "
+              f"as={t.assigned_rank}", flush=True)
+        eng = NativeEngine(t.assigned_rank, t.new_size,
+                           executor=local_executor,
+                           coordinator_host="127.0.0.1",
+                           coordinator_port=port, cycle_time_ms=2.0,
+                           epoch=t.epoch)
+        i = t.epoch * 1000
+    else:
+        eng = NativeEngine(rank, n, executor=local_executor,
+                           coordinator_host="127.0.0.1",
+                           coordinator_port=port, cycle_time_ms=2.0)
+        i = 0
+    elastic.attach(eng)
+    # Run until the whole job is back at full size AND a common milestone
+    # is reached — the epoch resynchronizes the name counter after every
+    # reconfiguration, so all members count in lockstep.
+    while True:
+        try:
+            faults.step(i, rank=eng.rank if eng.size == n else -1)
+            h = eng.enqueue(f"s{i}", np.ones(8, np.float32), OP_ALLREDUCE)
+            eng.synchronize(h, timeout_s=120.0)
+            i += 1
+            if eng.size == n and eng.epoch >= 2 and i >= eng.epoch * 1000 + 20:
+                print(f"RANK{rank} DONE size={eng.size} as={eng.rank} "
+                      f"epoch={eng.epoch}", flush=True)
+                break
+            time.sleep(0.05)
+        except MembershipChanged:
+            ev = elastic.reconfigure()
+            eng = em.peek_engine()
+            i = ev.epoch * 1000
+            print(f"RANK{rank} RECONFIGURED epoch={ev.epoch} "
+                  f"size={ev.new_size}", flush=True)
+        except CollectiveError as e:
+            print(f"RANK{rank} ABORTED {e}", flush=True)
+            time.sleep(30)
+            sys.exit(3)
+    eng.shutdown()
+""")
+
+
+def test_launcher_relaunches_single_rank_which_rejoins():
+    """Grow path end to end: ``--elastic`` supervision SIGKILLs rank 2 via
+    the fault injector, relaunches ONLY rank 2 (survivors keep running,
+    shrunk), the relaunch JOINs and the job returns to size 3 — exit 0,
+    with the rejoin accounted separately from full restarts in the
+    supervisor summary."""
+    env = {**os.environ, "PYTHONPATH": REPO, **FAST_HB,
+           "HVD_TPU_RESTART_BACKOFF": "0.1",
+           "HVD_TPU_FAULT_KILL_RANK": "2",
+           "HVD_TPU_FAULT_KILL_STEP": "10"}
+    res = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "3", "--elastic",
+         "--platform", "", "--max-restarts", "2", "--",
+         sys.executable, "-c", LAUNCHED_ELASTIC],
+        cwd=REPO, capture_output=True, text=True, timeout=scaled(180),
+        env=env)
+    assert res.returncode == 0, res.stdout[-4000:] + res.stderr[-2000:]
+    assert "killing rank 2 at step 10" in res.stdout, res.stdout[-4000:]
+    # Survivors shrank in place (no full-job teardown)...
+    assert "RANK0 RECONFIGURED epoch=1 size=2" in res.stdout, \
+        res.stdout[-4000:]
+    assert "relaunching only rank 2" in res.stderr, res.stderr[-2000:]
+    # ... the relaunched rank was admitted with a JOIN ticket ...
+    assert "RANK2 TICKET epoch=2 size=3 as=2" in res.stdout, \
+        res.stdout[-4000:]
+    # ... and every member finished at full size.
+    for r in range(3):
+        assert f"RANK{r} DONE size=3" in res.stdout, res.stdout[-4000:]
+    # Accounting: one single-rank relaunch, zero full-job restarts.
+    assert "supervisor summary: full_restarts=0 single_rank_relaunches=1" \
+        in res.stderr, res.stderr[-2000:]
+    assert "restarting (attempt" not in res.stderr, res.stderr[-2000:]
+
+
+# TSAN: reconfiguration racing client threads and shutdown.
+TSAN_ELASTIC = textwrap.dedent("""
+    import sys, threading, time
+    import numpy as np
+    from horovod_tpu.core.engine import NativeEngine, OP_ALLREDUCE, \\
+        CollectiveError, MembershipChanged
+    from horovod_tpu.core import engine as em
+    from horovod_tpu.core.executors import local_executor
+    from horovod_tpu import elastic
+
+    rank, port, n = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    eng = NativeEngine(rank, n, executor=local_executor,
+                       coordinator_host="127.0.0.1", coordinator_port=port,
+                       cycle_time_ms=1.0)
+    elastic.attach(eng)
+    resized = threading.Event()
+    stop = threading.Event()
+
+    def pound(tid):
+        i = 0
+        while not stop.is_set() and i < 200:
+            try:
+                e = em.peek_engine()
+                h = e.enqueue(f"t{tid}.{i}", np.ones(16, np.float32),
+                              OP_ALLREDUCE)
+                e.synchronize(h, timeout_s=60.0)
+            except MembershipChanged:
+                resized.set()
+                return
+            except (CollectiveError, RuntimeError, TimeoutError):
+                stop.set()
+                return
+            i += 1
+
+    threads = [threading.Thread(target=pound, args=(t,)) for t in range(2)]
+    for t in threads: t.start()
+    if rank == 1:
+        time.sleep(0.5)
+        import os, signal
+        os.kill(os.getpid(), signal.SIGKILL)
+    # Rank 0: wait for the resize signal, reconfigure (to size 1 —
+    # loopback) while the pound threads drain, then immediately shut the
+    # fresh engine down: reconfigure vs client threads vs teardown.
+    assert resized.wait(timeout=120), "no resize observed"
+    ev = elastic.reconfigure()
+    stop.set()
+    for t in threads: t.join()
+    e = em.peek_engine()
+    h = e.enqueue("post.reconfig", np.ones(4, np.float32), OP_ALLREDUCE)
+    e.synchronize(h, timeout_s=60.0)
+    e.shutdown()
+    print(f"RANK{rank} OK epoch={ev.epoch}", flush=True)
+""")
+
+
+@pytest.mark.tsan
+@pytest.mark.slow
+def test_concurrent_reconfigure_and_shutdown_under_tsan():
+    """ThreadSanitizer leg (make check): a real peer death triggering the
+    elastic RECONFIG path while client threads pound enqueues, followed by
+    an immediate post-reconfigure collective and teardown.  No data-race
+    report may implicate libhvdcore."""
+    core = os.path.join(REPO, "horovod_tpu", "core")
+    rc = subprocess.run(["make", "-C", core, "tsan", "-j4"],
+                        capture_output=True)
+    if rc.returncode != 0 and not os.path.exists(
+            os.path.join(core, "libhvdcore_tsan.so")):
+        pytest.skip("tsan build unavailable")
+    runtime = tsan_runtime()
+    if runtime is None:
+        pytest.skip("libtsan runtime not installed")
+    port = _free_port()
+    env = {**os.environ, "PYTHONPATH": REPO, **FAST_HB,
+           # TSAN is ~10x slower: only injected deaths may fire, and the
+           # reconfig hand-off needs real slack.
+           "HVD_TPU_HEARTBEAT_TIMEOUT_MS": str(int(scaled(8000))),
+           "HVD_TPU_ABORT_GRACE_MS": "5000",
+           "HVD_TPU_RECONFIG_TIMEOUT_MS": str(int(scaled(60000))),
+           "HVD_CORE_LIB": "libhvdcore_tsan.so",
+           "LD_PRELOAD": runtime,
+           "TSAN_OPTIONS": "report_bugs=1 halt_on_error=0 exitcode=0"}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", TSAN_ELASTIC, str(r), str(port), "2"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=REPO)
+        for r in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            outs.append(p.communicate(timeout=scaled(240)))
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+    assert "RANK0 OK epoch=1" in outs[0][0], (outs[0][0][-2000:],
+                                              outs[0][1][-3000:])
+    for r, (out, err) in enumerate(outs):
+        for chunk in err.split("WARNING: ThreadSanitizer")[1:]:
+            assert "hvdcore" not in chunk.split("=" * 18)[0], (
+                f"tsan race in libhvdcore on rank {r}:\n{chunk[:4000]}")

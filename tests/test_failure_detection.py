@@ -338,6 +338,94 @@ def test_chaos_soak_never_hangs(mode):
                                            outs[1][-2000:])
 
 
+# Elastic chaos soak (docs/fault_tolerance.md "In-place recovery"): the
+# same wire-fault scenarios under HVD_TPU_ELASTIC=1 with 3 processes and
+# rank 2 misbehaving.  Every scenario must end in a CLEAN SHRINK for the
+# survivors (continue collectives at the new epoch, exit 0) and a
+# structured restartable abort for the removed rank — never a hang.  The
+# epoch-keyed fault plans (…@0) disarm themselves in the re-formed
+# epoch-1 control plane, which is exactly why the plans are keyed.
+ELASTIC_CHAOS_WORKER = textwrap.dedent("""
+    import sys, time
+    import numpy as np
+    from horovod_tpu.core.engine import NativeEngine, OP_ALLREDUCE, \\
+        CollectiveError, MembershipChanged
+    from horovod_tpu.core import engine as em
+    from horovod_tpu.core.executors import local_executor
+    from horovod_tpu import elastic
+
+    rank, port, n = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    eng = NativeEngine(rank, n, executor=local_executor,
+                       coordinator_host="127.0.0.1", coordinator_port=port,
+                       cycle_time_ms=2.0)
+    elastic.attach(eng)
+    i, done_after_resize = 0, 0
+    while True:
+        try:
+            h = eng.enqueue(f"s{i}", np.ones(8, np.float32), OP_ALLREDUCE)
+            eng.synchronize(h, timeout_s=120.0)
+            i += 1
+            if i == 5:
+                print(f"RANK{rank} STEADY", flush=True)
+            if eng.epoch > 0:
+                done_after_resize += 1
+                if done_after_resize >= 10:
+                    print(f"RANK{rank} SHRUNK-OK size={eng.size} "
+                          f"epoch={eng.epoch}", flush=True)
+                    break
+        except MembershipChanged:
+            try:
+                ev = elastic.reconfigure()
+            except MembershipChanged as e:
+                # WE were the rank removed: the engine's restartable
+                # exit (75) is scheduled — wait for it, never hang.
+                print(f"RANK{rank} EXPELLED {e}", flush=True)
+                time.sleep(30)
+                sys.exit(3)
+            eng = em.peek_engine()
+            i = ev.epoch * 1000
+        except CollectiveError as e:
+            print(f"RANK{rank} ABORTED {e}", flush=True)
+            time.sleep(30)  # the abort grace exits 75
+            sys.exit(3)
+    eng.shutdown()
+""")
+
+
+@pytest.mark.parametrize("mode", ["KILL", "DROP", "CORRUPT", "PARTITION",
+                                  "HALFCLOSE"])
+def test_chaos_soak_elastic_shrinks_or_aborts_never_hangs(mode):
+    frame = 30 + (CHAOS_SEED + sum(map(ord, mode))) % 40
+    extra = {"HVD_TPU_ELASTIC": "1",
+             "HVD_TPU_RECONFIG_TIMEOUT_MS": str(int(scaled(20000)))}
+    if mode != "KILL":
+        extra[f"HVD_TPU_FAULT_WIRE_{mode}"] = f"2:{frame}@0"
+    procs, _ = _spawn(ELASTIC_CHAOS_WORKER, 3, extra)
+    try:
+        if mode == "KILL":
+            deadline = time.monotonic() + scaled(60)
+            for p in procs:
+                _wait_steady(p, deadline)
+            procs[2].send_signal(signal.SIGKILL)
+        outs = _drain(procs, timeout=scaled(90))  # bound: never deadlocks
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    # Survivors: clean in-place shrink to size 2 at epoch 1, then exit 0.
+    for r in (0, 1):
+        assert procs[r].returncode == 0, (mode, procs[r].returncode,
+                                          outs[r][-2500:])
+        assert "SHRUNK-OK size=2 epoch=1" in outs[r], (mode,
+                                                       outs[r][-2500:])
+    # The misbehaving rank: dead (KILL), expelled via RECONFIG, or
+    # self-aborted on its own structured detection (PARTITION cannot hear
+    # the verdict) — always the restartable exit, never a hang.
+    if mode != "KILL":
+        assert procs[2].returncode == 75, (mode, procs[2].returncode,
+                                           outs[2][-2500:])
+
+
 # Launcher end-to-end (jax-free children): injected SIGKILL at a step, the
 # survivor exits 75 via the peer-failure path, and the supervisor
 # relaunches; the relaunched attempt runs clean because injectors key off

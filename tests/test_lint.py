@@ -243,6 +243,69 @@ def test_hvd105_inactive_without_mesh_declaration():
 
 
 # ---------------------------------------------------------------------------
+# HVD106 — topology values cached where elastic resize can't reach them
+# ---------------------------------------------------------------------------
+
+def test_hvd106_module_level_size_constant():
+    assert codes("""
+        import horovod_tpu as hvd
+
+        WORLD = hvd.size()
+
+        def shard(data):
+            return data[::WORLD]
+    """) == ["HVD106"]
+
+
+def test_hvd106_default_parameter_value():
+    assert codes("""
+        import horovod_tpu as hvd
+
+        def scale_lr(lr, world=hvd.size()):
+            return lr * world
+    """) == ["HVD106"]
+
+
+def test_hvd106_rank_in_class_constant_and_derived_expression():
+    assert codes("""
+        from horovod_tpu import rank
+
+        class Cfg:
+            is_chief = rank() == 0
+    """) == ["HVD106"]
+
+
+def test_hvd106_clean_call_at_use_time_and_unrelated_size():
+    # Calling at use time is the fix; q.size() on some object is not a
+    # topology call and module-level constants from it are fine.
+    assert codes("""
+        import horovod_tpu as hvd
+
+        N = my_queue.size()
+
+        def shard(data):
+            return data[:: hvd.size()]
+
+        def inner():
+            world = hvd.size()   # runtime local: re-read every call
+            return world
+    """) == []
+
+
+def test_hvd106_exempt_when_refreshed_in_on_reconfigure_callback():
+    assert codes("""
+        import horovod_tpu as hvd
+
+        WORLD = hvd.size()
+
+        @hvd.on_reconfigure
+        def _refresh(event):
+            global WORLD
+            WORLD = hvd.size()
+    """) == []
+
+
+# ---------------------------------------------------------------------------
 # Suppression + driver behaviour
 # ---------------------------------------------------------------------------
 

@@ -161,6 +161,69 @@ def test_no_restart_by_default():
     assert "restarting" not in res.stderr
 
 
+# Elastic supervision accounting (docs/fault_tolerance.md "In-place
+# recovery"): rank 1 dies on its founding launch but succeeds as a JOIN
+# relaunch; the other ranks linger long enough to stay "alive" while the
+# single-rank relaunch happens, then exit clean.
+ELASTIC_ACCOUNTING_SCRIPT = textwrap.dedent("""
+    import os, sys, time
+    rank = int(os.environ["JAX_PROCESS_ID"])
+    joined = os.environ.get("HVD_TPU_ELASTIC_JOIN") == "1"
+    if rank == 1 and not joined:
+        time.sleep(0.3)
+        sys.exit(75)          # the expelled/aborted-rank exit
+    if rank == 1 and joined:
+        print("REJOINED attempt="
+              + os.environ.get("HVD_TPU_RESTART_ATTEMPT", "?"), flush=True)
+        sys.exit(0)
+    time.sleep(2.0)           # survivors keep running through the rejoin
+    sys.exit(0)
+""")
+
+
+def test_elastic_single_rank_relaunch_accounting_and_breaker_reset():
+    """--elastic supervision: a dead non-coordinator rank is relaunched
+    ALONE with HVD_TPU_ELASTIC_JOIN=1 (survivors keep running — no job
+    teardown, no full restart), the relaunch gets a fresh attempt counter
+    so step-keyed injectors stay disarmed, and the supervisor summary
+    accounts it separately from full-job restarts."""
+    res = _supervised(3, ELASTIC_ACCOUNTING_SCRIPT, "--elastic",
+                      "--max-restarts", "1", timeout=scaled(60))
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "elastic mode: relaunching only rank 1" in res.stderr, res.stderr
+    # The relaunched incarnation carries a bumped attempt counter (faults
+    # keyed to attempt 0 must not re-fire inside the rejoin).
+    assert "REJOINED attempt=1" in res.stdout, res.stdout
+    # Separate accounting: one single-rank relaunch, zero full restarts —
+    # and no mpirun-style job abort was triggered.
+    assert "supervisor summary: full_restarts=0 single_rank_relaunches=1" \
+        in res.stderr, res.stderr
+    assert "terminating remaining ranks" not in res.stderr, res.stderr
+    assert "restarting (attempt" not in res.stderr, res.stderr
+
+
+def test_elastic_rank0_death_still_aborts_job():
+    """Coordinator failover is out of scope: rank 0 dying under --elastic
+    keeps the mpirun job-abort + full-restart contract."""
+    script = textwrap.dedent("""
+        import os, sys, time
+        rank = int(os.environ["JAX_PROCESS_ID"])
+        attempt = int(os.environ.get("HVD_TPU_RESTART_ATTEMPT", "0"))
+        if attempt > 0:
+            sys.exit(0)
+        if rank == 0:
+            time.sleep(0.3)
+            sys.exit(75)
+        time.sleep(120)
+    """)
+    res = _supervised(2, script, "--elastic", "--max-restarts", "1",
+                      timeout=scaled(60))
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "relaunching only rank" not in res.stderr, res.stderr
+    assert "restarting (attempt 1" in res.stderr, res.stderr
+    assert "supervisor summary: full_restarts=1" in res.stderr, res.stderr
+
+
 def test_sigterm_reaps_grandchildren():
     import signal
     import time
