@@ -36,7 +36,8 @@ __version__ = "0.1.0"
 _ATTR_HOME = {}
 for _mod, _names in {
     "horovod_tpu.basics": (
-        "NotInitializedError", "cache_stats", "chips_per_slice", "cross_rank",
+        "NotInitializedError", "cache_stats", "chips_per_slice",
+        "coord_state", "cross_rank",
         "cross_size", "failure_report", "init", "is_initialized",
         "local_num_chips", "local_rank", "local_size", "member_process_ids",
         "mpi_threads_supported", "num_chips", "rank", "shutdown", "size",
@@ -44,7 +45,8 @@ for _mod, _names in {
     ),
     "horovod_tpu.analysis.schedule": ("divergence_report",),
     "horovod_tpu.core.engine": ("CollectiveError", "MembershipChanged"),
-    "horovod_tpu.elastic": ("on_reconfigure", "resize_event"),
+    "horovod_tpu.elastic": ("coordinator_endpoint", "on_reconfigure",
+                            "resize_event"),
     "horovod_tpu.mesh": (
         "DATA_AXIS", "data_sharding", "data_spec", "global_mesh",
         "replicated_sharding",

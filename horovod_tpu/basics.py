@@ -375,6 +375,29 @@ def failure_report() -> dict | None:
     return _engine.failure_report()
 
 
+def coord_state() -> dict | None:
+    """The coordinator state replicated onto this rank — non-``None`` only
+    on the designated standby of an elastic job (docs/fault_tolerance.md
+    "Coordinator failover").
+
+    The coordinator streams its authoritative-only state to the standby in
+    ``STATE`` frames each monitor tick; this returns the newest snapshot::
+
+        {"epoch": 3, "joins_admitted": 1, "verify_checked": 120,
+         "verify_tick": 124, "lru_order": [5, 2, 0, ...]}
+
+    ``epoch`` is the load-bearing field — a promotion resumes from
+    ``max(local, replicated) + 1`` so stale frames from the previous reign
+    are rejected wire-level.  The rest aligns the successor's verifier and
+    response-cache bookkeeping and gives tests a replication probe.  The
+    coordinator reports its own outbound snapshot; plain (non-standby)
+    workers and engines that never started report ``None``."""
+    _topo()
+    from horovod_tpu.core import engine as _engine
+
+    return _engine.coord_state()
+
+
 def cache_stats() -> dict:
     """Response-cache counters for this rank's eager control plane
     (docs/response_cache.md): ``{"hits", "misses", "evictions",

@@ -148,6 +148,9 @@ def _load_library() -> ctypes.CDLL:
                                      ctypes.c_int]
     lib.hvd_resize_ack.restype = None
     lib.hvd_resize_ack.argtypes = [ctypes.c_void_p]
+    lib.hvd_coord_state.restype = ctypes.c_int
+    lib.hvd_coord_state.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_int]
     lib.hvd_detach_listener.restype = None
     lib.hvd_detach_listener.argtypes = [ctypes.c_void_p]
     lib.hvd_poll.restype = ctypes.c_int
@@ -481,11 +484,15 @@ class NativeEngine:
         a reconfiguration verdict stopped this engine, a dict::
 
             {"epoch": 1, "old_rank": 2, "new_rank": 1, "old_size": 3,
-             "new_size": 2, "failed_rank": 1, "cause": "connection_reset"}
+             "new_size": 2, "failed_rank": 1, "cause": "connection_reset",
+             "new_coord_host": "", "new_coord_port": 0}
 
-        ``failed_rank`` is -1 for a grow (a relaunched rank rejoined).  The
-        engine is stopped at this point — ``elastic.reconfigure()`` acks
-        the event and re-forms the engine under the new membership."""
+        ``failed_rank`` is -1 for a grow (a relaunched rank rejoined).
+        After a coordinator failover ``new_coord_host``/``new_coord_port``
+        name the promoted standby's endpoint (empty host = the coordinator
+        did not move).  The engine is stopped at this point —
+        ``elastic.reconfigure()`` acks the event and re-forms the engine
+        under the new membership."""
         buf = ctypes.create_string_buffer(1 << 12)
         n = self._lib.hvd_resize_event(self._ptr, buf, len(buf))
         if n < -1:
@@ -508,22 +515,76 @@ class NativeEngine:
             off += 8
             return v
 
+        def s():
+            nonlocal off
+            ln = i32()
+            v = raw[off:off + ln].decode()
+            off += ln
+            return v
+
         if i32() == 0:
             return None
         epoch = i64()
         old_rank, new_rank, old_size, new_size, failed_rank = (
             i32(), i32(), i32(), i32(), i32())
-        ln = i32()
-        cause = raw[off:off + ln].decode()
+        cause = s()
+        new_coord_host = s()
+        new_coord_port = i32()
         return {"epoch": epoch, "old_rank": old_rank, "new_rank": new_rank,
                 "old_size": old_size, "new_size": new_size,
-                "failed_rank": failed_rank, "cause": cause}
+                "failed_rank": failed_rank, "cause": cause,
+                "new_coord_host": new_coord_host,
+                "new_coord_port": new_coord_port}
 
     def resize_ack(self) -> None:
         """Acknowledge the resize event: stands the native engine's bounded
         reconfig-timeout fallback exit down so this process can re-form the
         engine in place (called by ``elastic.reconfigure``)."""
         self._lib.hvd_resize_ack(self._ptr)
+
+    def coord_state(self) -> dict | None:
+        """The last coordinator-state delta this rank has seen
+        (docs/fault_tolerance.md "Coordinator failover"): the coordinator's
+        own emission on rank 0, the replicated copy on the designated
+        standby, ``None`` elsewhere::
+
+            {"epoch": 0, "joins_admitted": 0, "verify_checked": 12,
+             "verify_tick": 40, "lru_order": [3, 1, 0, 2]}
+
+        Observability for the standby-replication stream — tests use it to
+        assert the standby's view was current before a coordinator kill."""
+        buf = ctypes.create_string_buffer(1 << 14)
+        n = self._lib.hvd_coord_state(self._ptr, buf, len(buf))
+        if n < -1:
+            buf = ctypes.create_string_buffer(-n + 16)
+            n = self._lib.hvd_coord_state(self._ptr, buf, len(buf))
+        if n <= 0:
+            return None
+        raw = buf.raw[:n]
+        off = 0
+
+        def i32():
+            nonlocal off
+            v = struct.unpack_from("<i", raw, off)[0]
+            off += 4
+            return v
+
+        def i64():
+            nonlocal off
+            v = struct.unpack_from("<q", raw, off)[0]
+            off += 8
+            return v
+
+        if i32() == 0:
+            return None
+        epoch = i64()
+        joins_admitted = i64()
+        verify_checked = i64()
+        verify_tick = i64()
+        lru_order = [i32() for _ in range(i32())]
+        return {"epoch": epoch, "joins_admitted": joins_admitted,
+                "verify_checked": verify_checked, "verify_tick": verify_tick,
+                "lru_order": lru_order}
 
     def detach_listener(self) -> None:
         """Coordinator, reconfiguration hand-off: release the control-plane
@@ -733,6 +794,15 @@ def resize_event() -> dict | None:
     with _engine_lock:
         eng = _engine
     return eng.resize_event() if eng is not None else None
+
+
+def coord_state() -> dict | None:
+    """Module-level coordinator-state replica view; ``None`` when the
+    engine was never started or this rank is neither the coordinator nor
+    the designated standby."""
+    with _engine_lock:
+        eng = _engine
+    return eng.coord_state() if eng is not None else None
 
 
 def replace_engine(old: NativeEngine | None,

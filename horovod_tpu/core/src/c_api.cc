@@ -264,9 +264,11 @@ int hvd_failure_report(void* e, char* buf, int buflen) {
 
 // Serialized elastic resize event (docs/fault_tolerance.md "In-place
 // recovery"): i32 present (0 = none), then {i64 epoch, i32 old_rank,
-// i32 new_rank, i32 old_size, i32 new_size, i32 failed_rank, str cause}.
-// Returns bytes written, or -needed-1 when buflen is too small
-// (hvd_next_batch's grow-and-retry convention).
+// i32 new_rank, i32 old_size, i32 new_size, i32 failed_rank, str cause,
+// str new_coord_host, i32 new_coord_port} — the last two name the NEW
+// membership's coordinator endpoint after a failover (empty host = the
+// coordinator did not move).  Returns bytes written, or -needed-1 when
+// buflen is too small (hvd_next_batch's grow-and-retry convention).
 int hvd_resize_event(void* e, char* buf, int buflen) {
   auto v = static_cast<Engine*>(e)->ResizeEvent();
   Writer w;
@@ -281,6 +283,36 @@ int hvd_resize_event(void* e, char* buf, int buflen) {
     w.i32(v.new_size);
     w.i32(v.failed_rank);
     w.str(v.cause);
+    w.str(v.new_coord_host);
+    w.i32(v.new_coord_port);
+  }
+  if (static_cast<int>(w.buf.size()) > buflen) {
+    return -static_cast<int>(w.buf.size()) - 1;
+  }
+  std::memcpy(buf, w.buf.data(), w.buf.size());
+  return static_cast<int>(w.buf.size());
+}
+
+// Serialized coordinator-state replica (docs/fault_tolerance.md
+// "Coordinator failover"): i32 present (0 = this rank has seen no STATE
+// delta), then {i64 epoch, i64 joins_admitted, i64 verify_checked,
+// i64 verify_tick, i32 n_lru, i32 bits...}.  Present on the coordinator
+// (its own emission) and on the designated standby (the replicated copy);
+// lets tests assert replication reached the standby before a kill.
+// Returns bytes written, or -needed-1 (grow-and-retry convention).
+int hvd_coord_state(void* e, char* buf, int buflen) {
+  auto v = static_cast<Engine*>(e)->CoordStateReport();
+  Writer w;
+  if (!v.present) {
+    w.i32(0);
+  } else {
+    w.i32(1);
+    w.i64(v.state.epoch);
+    w.i64(v.state.joins_admitted);
+    w.i64(v.state.verify_checked);
+    w.i64(v.state.verify_tick);
+    w.i32(static_cast<int32_t>(v.state.lru_order.size()));
+    for (int32_t bit : v.state.lru_order) w.i32(bit);
   }
   if (static_cast<int>(w.buf.size()) > buflen) {
     return -static_cast<int>(w.buf.size()) - 1;

@@ -179,6 +179,32 @@ struct Backoff {
 // TcpControlPlane
 // ---------------------------------------------------------------------------
 
+int TcpControlPlane::BindListener(int* port, std::string* err) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) {
+    *err = "socket() failed";
+    return -1;
+  }
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(*port));
+  // Backlog sized for the failover window: every survivor's re-rendezvous
+  // connect can park here before the promoted standby starts accepting.
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      ::listen(fd, 64) != 0) {
+    *err = "bind/listen failed on port " + std::to_string(*port);
+    ::close(fd);
+    return -1;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  *port = ntohs(addr.sin_port);
+  return fd;
+}
+
 std::unique_ptr<TcpControlPlane> TcpControlPlane::MakeCoordinator(
     int port, int size, int64_t epoch, std::string* err) {
   std::unique_ptr<TcpControlPlane> cp(new TcpControlPlane());
@@ -188,27 +214,16 @@ std::unique_ptr<TcpControlPlane> TcpControlPlane::MakeCoordinator(
   cp->epoch_ = static_cast<uint16_t>(epoch & 0xFFFF);
   cp->wire_version_ = WireVersionFromEnv();
   cp->fault_ = ParseWireFaultEnv(epoch);
-  cp->listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
-  if (cp->listen_fd_ < 0) {
-    *err = "socket() failed";
-    return nullptr;
-  }
+  cp->port_ = port;
+  cp->listen_fd_ = BindListener(&cp->port_, err);
+  if (cp->listen_fd_ < 0) return nullptr;
   int one = 1;
-  ::setsockopt(cp->listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
-  sockaddr_in addr{};
-  addr.sin_family = AF_INET;
-  addr.sin_addr.s_addr = htonl(INADDR_ANY);
-  addr.sin_port = htons(static_cast<uint16_t>(port));
-  if (::bind(cp->listen_fd_, reinterpret_cast<sockaddr*>(&addr),
-             sizeof(addr)) != 0 ||
-      ::listen(cp->listen_fd_, size) != 0) {
-    *err = "bind/listen failed on port " + std::to_string(port);
-    return nullptr;
-  }
-  socklen_t alen = sizeof(addr);
-  ::getsockname(cp->listen_fd_, reinterpret_cast<sockaddr*>(&addr), &alen);
-  cp->port_ = ntohs(addr.sin_port);
   cp->worker_fds_.assign(static_cast<size_t>(size > 0 ? size - 1 : 0), -1);
+  // Succession bookkeeping: each admitted worker's HELLO advertises its
+  // pre-bound standby listen port (0 = none); its address comes from the
+  // accepted connection itself.
+  std::vector<int32_t> standby_ports(cp->worker_fds_.size(), 0);
+  std::vector<std::string> peer_hosts(cp->worker_fds_.size());
   // Bounded accept: a worker that died pre-connect must surface as an error
   // here, not hang the coordinator forever (the silent-hang analog of the
   // reference's stall contract).  The listen fd is non-blocking because a
@@ -320,12 +335,12 @@ std::unique_ptr<TcpControlPlane> TcpControlPlane::MakeCoordinator(
       return nullptr;
     }
     hello_ok = hello_hdr.type == static_cast<uint8_t>(FrameType::HELLO) &&
-               hello_hdr.payload_len == 4;
+               hello_hdr.payload_len == 8;
     if (hello_ok) {
-      hello.resize(4);
+      hello.resize(8);
       ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
-      hello_ok = RecvAll(fd, hello.data(), 4) &&
-                 Crc32(hello.data(), 4) == hello_hdr.crc32;
+      hello_ok = RecvAll(fd, hello.data(), 8) &&
+                 Crc32(hello.data(), 8) == hello_hdr.crc32;
       ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &zero, sizeof(zero));
     }
     if (!hello_ok) {
@@ -339,6 +354,16 @@ std::unique_ptr<TcpControlPlane> TcpControlPlane::MakeCoordinator(
       *err = "bad hello rank " + std::to_string(rank);
       return nullptr;
     }
+    int32_t standby_port = 0;
+    std::memcpy(&standby_port, hello.data() + 4, 4);
+    standby_ports[rank - 1] = standby_port;
+    sockaddr_in peer{};
+    socklen_t plen = sizeof(peer);
+    char host_buf[INET_ADDRSTRLEN] = "127.0.0.1";
+    if (::getpeername(fd, reinterpret_cast<sockaddr*>(&peer), &plen) == 0) {
+      ::inet_ntop(AF_INET, &peer.sin_addr, host_buf, sizeof(host_buf));
+    }
+    peer_hosts[rank - 1] = host_buf;
     cp->worker_fds_[rank - 1] = fd;
     if (!cp->SendTypedFrame(fd, FrameType::HELLO_ACK, "", rank)) {
       *err = "hello ack send failed to rank " + std::to_string(rank);
@@ -349,18 +374,68 @@ std::unique_ptr<TcpControlPlane> TcpControlPlane::MakeCoordinator(
   cp->last_rx_.assign(cp->worker_fds_.size(),
                       std::chrono::steady_clock::now());
   cp->failed_.store(false);  // handshake sends must not pre-arm a failure
+  // Designate the standby coordinator — the lowest rank that pre-bound a
+  // succession listener (HVD_TPU_STANDBY overrides the choice) — and
+  // announce it to everyone so succession needs no out-of-band discovery
+  // (docs/fault_tolerance.md "Coordinator failover").
+  StandbyInfo standby;
+  const char* pick = ::getenv("HVD_TPU_STANDBY");
+  int want = (pick != nullptr && *pick != '\0') ? ::atoi(pick) : -1;
+  for (size_t i = 0; i < standby_ports.size(); ++i) {
+    if (standby_ports[i] <= 0) continue;
+    int r = static_cast<int>(i) + 1;
+    if (want >= 1 && r != want) continue;
+    standby.standby_rank = r;
+    standby.host = peer_hosts[i];
+    standby.port = standby_ports[i];
+    break;
+  }
+  if (standby.standby_rank >= 1) {
+    std::string payload;
+    Serialize(standby, &payload);
+    for (size_t i = 0; i < cp->worker_fds_.size(); ++i) {
+      if (cp->worker_fds_[i] < 0) continue;
+      cp->SendTypedFrame(cp->worker_fds_[i], FrameType::STANDBY, payload,
+                         static_cast<int>(i) + 1);
+    }
+    std::lock_guard<std::mutex> l(cp->state_mu_);
+    cp->standby_ = standby;
+    cp->has_standby_ = true;
+  }
+  cp->failed_.store(false);  // standby broadcast is best effort, too
   return cp;
 }
 
 std::unique_ptr<TcpControlPlane> TcpControlPlane::MakeWorker(
     const std::string& host, int port, int rank, int64_t epoch,
-    std::string* err) {
+    std::string* err, bool standby) {
   std::unique_ptr<TcpControlPlane> cp(new TcpControlPlane());
   cp->coordinator_ = false;
   cp->rank_ = rank;
   cp->epoch_ = static_cast<uint16_t>(epoch & 0xFFFF);
   cp->wire_version_ = WireVersionFromEnv();
   cp->fault_ = ParseWireFaultEnv(epoch);
+  if (standby) {
+    // Pre-bind the succession listener BEFORE the handshake so its port
+    // rides the HELLO: if this rank is later designated standby and the
+    // coordinator dies, survivors connect here and park in the backlog
+    // until the promoted plane starts accepting.  Failure to bind is not
+    // fatal — the job just runs without this rank as a succession
+    // candidate (port 0 in HELLO).
+    std::string bind_err;
+    int p = 0;
+    int fd = BindListener(&p, &bind_err);
+    if (fd >= 0) {
+      cp->standby_listen_fd_ = fd;
+      cp->standby_listen_port_ = p;
+    } else {
+      std::fprintf(stderr,
+                   "WARNING: horovod_tpu rank %d could not pre-bind a "
+                   "standby listener (%s); this rank is not a succession "
+                   "candidate\n",
+                   rank, bind_err.c_str());
+    }
+  }
   int one = 1;
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
@@ -405,9 +480,11 @@ std::unique_ptr<TcpControlPlane> TcpControlPlane::MakeWorker(
       soft_err = "connect refused/unreachable";
       continue;
     }
-    std::string hello(4, '\0');
+    std::string hello(8, '\0');
     int32_t r32 = rank;
+    int32_t sp32 = cp->standby_listen_port_;
     std::memcpy(hello.data(), &r32, 4);
+    std::memcpy(hello.data() + 4, &sp32, 4);
     if (!cp->SendTypedFrame(cp->sock_, FrameType::HELLO, hello, 0)) {
       ::close(cp->sock_);
       cp->sock_ = -1;
@@ -485,6 +562,7 @@ TcpControlPlane::~TcpControlPlane() {
     if (fd >= 0) ::close(fd);
   if (join_fd_ >= 0) ::close(join_fd_);
   if (listen_fd_ >= 0) ::close(listen_fd_);
+  if (standby_listen_fd_ >= 0) ::close(standby_listen_fd_);
 }
 
 // ---------------------------------------------------------------------------
@@ -567,6 +645,41 @@ bool TcpControlPlane::GetFailure(PeerFailureReport* out) const {
   if (!failed_.load()) return false;
   *out = failure_;
   return true;
+}
+
+bool TcpControlPlane::GetStandby(StandbyInfo* out) const {
+  std::lock_guard<std::mutex> l(state_mu_);
+  if (!has_standby_) return false;
+  *out = standby_;
+  return true;
+}
+
+bool TcpControlPlane::GetCoordState(CoordState* out) const {
+  std::lock_guard<std::mutex> l(state_mu_);
+  if (!has_coord_state_) return false;
+  *out = coord_state_;
+  return true;
+}
+
+void TcpControlPlane::SyncCoordState(const CoordState& state) {
+  if (!coordinator_) return;
+  int standby_rank;
+  {
+    std::lock_guard<std::mutex> l(state_mu_);
+    if (!has_standby_) return;
+    standby_rank = standby_.standby_rank;
+    coord_state_ = state;  // the coordinator's own copy, for observability
+    has_coord_state_ = true;
+  }
+  int idx = standby_rank - 1;
+  if (idx < 0 || static_cast<size_t>(idx) >= worker_fds_.size()) return;
+  int fd = worker_fds_[static_cast<size_t>(idx)];
+  if (fd < 0) return;
+  std::string payload;
+  Serialize(state, &payload);
+  // Best effort: a send failure here is a standby failure, recorded by
+  // SendTypedFrame like any other peer death.
+  SendTypedFrame(fd, FrameType::STATE, payload, standby_rank);
 }
 
 bool TcpControlPlane::SendTypedFrame(int fd, FrameType type,
@@ -707,6 +820,29 @@ bool TcpControlPlane::RecvDataFrame(int fd, int peer_rank, FrameType expect,
     NoteRx(peer_rank);
     FrameType t = static_cast<FrameType>(h.type);
     if (t == FrameType::HEARTBEAT) continue;
+    if (t == FrameType::STANDBY) {
+      // Succession announcement: remember who the designated standby is
+      // (and where it listens) and keep reading — this frame interleaves
+      // with the response stream like a heartbeat.
+      StandbyInfo info;
+      if (Deserialize(body.data(), body.size(), &info)) {
+        std::lock_guard<std::mutex> l(state_mu_);
+        standby_ = info;
+        has_standby_ = true;
+      }
+      continue;
+    }
+    if (t == FrameType::STATE) {
+      // Coordinator-state replication delta (this rank is the standby):
+      // newest frame wins; promotion reads it via GetCoordState.
+      CoordState state;
+      if (Deserialize(body.data(), body.size(), &state)) {
+        std::lock_guard<std::mutex> l(state_mu_);
+        coord_state_ = state;
+        has_coord_state_ = true;
+      }
+      continue;
+    }
     if (t == FrameType::ABORT) {
       PeerFailureReport report;
       if (Deserialize(body.data(), body.size(), &report)) {
@@ -872,6 +1008,12 @@ void TcpControlPlane::CloseListener() {
     ::close(listen_fd_);
     listen_fd_ = -1;
   }
+  // A promoted standby re-binds its succession listener's port as the new
+  // coordinator's rendezvous socket, so it must be released here too.
+  if (standby_listen_fd_ >= 0) {
+    ::close(standby_listen_fd_);
+    standby_listen_fd_ = -1;
+  }
 }
 
 void TcpControlPlane::SendJoinTicket(const JoinTicket& ticket) {
@@ -954,9 +1096,23 @@ bool TcpControlPlane::Gather(const RequestList& own,
     }
     if (pr == 0) continue;
     for (nfds_t s = 0; s < live; ++s) {
-      if ((pfds[s].revents & (POLLIN | POLLERR | POLLHUP)) == 0) continue;
+      if ((pfds[s].revents & (POLLIN | POLLERR | POLLHUP | POLLNVAL)) == 0) {
+        continue;
+      }
       size_t i = owner[s];
       int wrank = static_cast<int>(i) + 1;
+      if ((pfds[s].revents & POLLNVAL) != 0) {
+        // The fd went invalid under us (closed mid-gather — e.g. a failover
+        // or shutdown path tearing down the plane).  Without this branch
+        // poll() returns instantly with POLLNVAL forever and the old
+        // `revents & (POLLIN|POLLERR|POLLHUP)` mask skipped it: a 100% CPU
+        // busy-spin that never finished the gather.  Fail structurally.
+        RecordFailure(wrank, "connection_lost",
+                      "control-plane socket for rank " +
+                          std::to_string(wrank) +
+                          " became invalid mid-gather (POLLNVAL)");
+        return false;
+      }
       FrameState& f = st[i];
       // Drain what is available without blocking; partial frames keep
       // their state until the fd is readable again.
@@ -1231,6 +1387,23 @@ int32_t ResponseCache::AssignSlot(const std::string& name,
     return bit;
   }
   return -1;  // everything pinned: skip caching this response
+}
+
+std::vector<int32_t> ResponseCache::LruOrder() const {
+  return std::vector<int32_t>(lru_.begin(), lru_.end());
+}
+
+void ResponseCache::SetLruOrder(const std::vector<int32_t>& order) {
+  // Restore the replicated recency order onto whatever is occupied locally:
+  // mentioned bits move to the front in the given order; occupied bits the
+  // snapshot missed (races between snapshot and store) keep their relative
+  // order at the back.
+  for (auto rit = order.rbegin(); rit != order.rend(); ++rit) {
+    int32_t bit = *rit;
+    if (bit < 0 || static_cast<size_t>(bit) >= capacity_) continue;
+    Entry& e = slots_[static_cast<size_t>(bit)];
+    if (e.used) lru_.splice(lru_.begin(), lru_, e.lru_it);
+  }
 }
 
 // ---------------------------------------------------------------------------

@@ -79,6 +79,18 @@ class ControlPlane {
   // not processed the RECONFIG broadcast yet — closing them would RST the
   // peer and flush the un-read verdict out of its receive queue).
   virtual void CloseListener() {}
+
+  // Coordinator failover (docs/fault_tolerance.md "Coordinator failover").
+  // Worker side: the designated standby's endpoint, learned from the
+  // coordinator's post-rendezvous STANDBY broadcast; false while none was
+  // announced (non-elastic job, or the broadcast never arrived).
+  virtual bool GetStandby(StandbyInfo* /*out*/) const { return false; }
+  // Standby side: the last replicated CoordState delta from the
+  // coordinator's monitor thread; false before the first STATE frame.
+  virtual bool GetCoordState(CoordState* /*out*/) const { return false; }
+  // Coordinator side: stream the authoritative-only state to the standby
+  // (best effort; a send failure is a peer failure like any other).
+  virtual void SyncCoordState(const CoordState& /*state*/) {}
 };
 
 // Single-process transport: Exchange/Gather/Broadcast are pass-throughs.
@@ -117,10 +129,19 @@ class TcpControlPlane : public ControlPlane {
   static std::unique_ptr<TcpControlPlane> MakeCoordinator(int port, int size,
                                                           int64_t epoch,
                                                           std::string* err);
+  // ``standby``: pre-bind an ephemeral succession listener before the
+  // handshake and advertise its port in HELLO, so this worker can be
+  // promoted to coordinator without out-of-band discovery (elastic jobs;
+  // docs/fault_tolerance.md "Coordinator failover").
   static std::unique_ptr<TcpControlPlane> MakeWorker(const std::string& host,
                                                      int port, int rank,
                                                      int64_t epoch,
-                                                     std::string* err);
+                                                     std::string* err,
+                                                     bool standby = false);
+  // Bind+listen a TCP socket on `port` (0 = kernel-assigned); on success
+  // returns the fd and writes the bound port back through *port.  Shared by
+  // rendezvous, the standby pre-bind, and star_bench's port selection.
+  static int BindListener(int* port, std::string* err);
   ~TcpControlPlane() override;
 
   bool Exchange(const RequestList& send, ResponseList* recv) override;
@@ -138,6 +159,14 @@ class TcpControlPlane : public ControlPlane {
   int PollJoinRequest() override;
   void SendJoinTicket(const JoinTicket& ticket) override;
   void CloseListener() override;
+
+  bool GetStandby(StandbyInfo* out) const override;
+  bool GetCoordState(CoordState* out) const override;
+  void SyncCoordState(const CoordState& state) override;
+  // Worker: port of the pre-bound succession listener (0 = none).  The
+  // engine surfaces it as the elastic worker's bound_port so Python can
+  // re-bind the same endpoint when this rank is promoted.
+  int standby_listen_port() const { return standby_listen_port_; }
 
   // Env-driven wire-level chaos injection (faults.py table;
   // HVD_TPU_FAULT_WIRE_{DROP,CORRUPT,PARTITION,HALFCLOSE} =
@@ -199,6 +228,18 @@ class TcpControlPlane : public ControlPlane {
   int join_fd_ = -1;
   int join_id_ = -1;
   uint16_t epoch_ = 0;  // membership epoch stamped into frame flags
+
+  // Coordinator failover state (guarded by state_mu_ unless noted).
+  // Worker: succession listener pre-bound before HELLO (standby mode).
+  int standby_listen_fd_ = -1;
+  int standby_listen_port_ = 0;
+  // Both sides: the announced standby (coordinator: its own selection;
+  // worker: from the STANDBY broadcast).
+  StandbyInfo standby_;
+  bool has_standby_ = false;
+  // Standby worker: last replicated coordinator state (STATE frames).
+  CoordState coord_state_;
+  bool has_coord_state_ = false;
 
   uint8_t wire_version_ = kWireVersion;  // HVD_TPU_WIRE_VERSION override
   WireFaultSpec fault_;
@@ -272,6 +313,13 @@ class ResponseCache {
   // response is emitted).  Returns -1 when every slot is pinned.
   void Touch(int32_t bit);
   int32_t AssignSlot(const std::string& name, const std::set<int32_t>& pinned);
+
+  // Failover replication (docs/fault_tolerance.md "Coordinator failover"):
+  // snapshot / restore of the coordinator-only LRU recency order (front =
+  // most recently used).  SetLruOrder keeps only bits currently occupied and
+  // leaves unmentioned occupied bits at the back in their existing order.
+  std::vector<int32_t> LruOrder() const;
+  void SetLruOrder(const std::vector<int32_t>& order);
 
   Stats stats;
 
@@ -355,6 +403,12 @@ class Coordinator {
   // keep returning it).  Empty while schedules agree.
   std::vector<DivergenceEntry> CheckDivergence();
 
+  // Verifier interval position, readable from the monitor thread for
+  // standby replication (mutated by CheckDivergence on the cycle thread).
+  int64_t verify_checked() const {
+    return verify_checked_.load(std::memory_order_relaxed);
+  }
+
   size_t pending() const { return table_.size(); }
 
  private:
@@ -385,7 +439,7 @@ class Coordinator {
   // Verifier state: per-rank checkpoint streams, contiguous from
   // verify_checked_ (lower seqs already matched and were pruned).
   std::vector<std::deque<VerifyEntry>> verify_streams_;
-  int64_t verify_checked_ = 0;
+  std::atomic<int64_t> verify_checked_{0};
   std::vector<DivergenceEntry> divergence_;  // sticky once detected
 };
 

@@ -53,10 +53,14 @@ Status Engine::Start(int* bound_port) {
     control_ = std::move(cp);
   } else {
     std::string err;
+    // Elastic workers pre-bind a succession listener (standby=true): its
+    // port rides the HELLO, and Start reports it as this rank's bound
+    // port so Python can re-bind the same endpoint on promotion.
     auto cp = TcpControlPlane::MakeWorker(opts_.coordinator_host,
                                           opts_.coordinator_port, opts_.rank,
-                                          opts_.epoch, &err);
+                                          opts_.epoch, &err, opts_.elastic);
     if (!cp) return Status::Unknown("control plane: " + err);
+    if (bound_port != nullptr) *bound_port = cp->standby_listen_port();
     control_ = std::move(cp);
   }
   if (opts_.cache_capacity > 0) {
@@ -532,6 +536,23 @@ void Engine::MonitorLoop() {
       // itself away; the Python layer re-forms it at the grown size.
       return;
     }
+    if (opts_.elastic && control_->is_coordinator()) {
+      // Stream the authoritative-only coordinator state to the standby as
+      // a delta each monitor tick (docs/fault_tolerance.md "Coordinator
+      // failover").  The epoch is the load-bearing part — promotion picks
+      // max(local, replicated)+1 so a successor can never reuse one; the
+      // rest keeps the standby's view aligned for observability.
+      CoordState state;
+      state.epoch = opts_.epoch;
+      state.joins_admitted = joins_admitted_.load();
+      if (coordinator_) state.verify_checked = coordinator_->verify_checked();
+      state.verify_tick = verify_tick_.load();
+      {
+        std::lock_guard<std::mutex> l(mu_);
+        if (cache_.enabled()) state.lru_order = cache_.LruOrder();
+      }
+      control_->SyncCoordState(state);
+    }
     if (!control_->HeartbeatTick(opts_.heartbeat_timeout_ms / 1000.0)) {
       continue;
     }
@@ -573,9 +594,9 @@ void Engine::HandlePeerFailure(PeerFailureReport report) {
   if (!failure_handled_.compare_exchange_strong(expected, true)) return;
   // Elastic shrink decision (coordinator only — workers never observe a
   // non-coordinator peer directly; they receive the RECONFIG verdict).  A
-  // dead COORDINATOR, or a shrink below the HVD_TPU_MIN_SIZE floor, keeps
-  // the legacy abort-and-restart path; coordinator failover is out of
-  // scope (docs/fault_tolerance.md recovery-mode matrix).
+  // shrink below the HVD_TPU_MIN_SIZE floor keeps the legacy
+  // abort-and-restart path; a dead coordinator takes the failover branch
+  // below (docs/fault_tolerance.md recovery-mode matrix).
   if (opts_.elastic && control_->is_coordinator() && report.failed_rank > 0 &&
       report.failed_rank < opts_.size &&
       opts_.size - 1 >= std::max(opts_.min_size, 1) &&
@@ -599,6 +620,67 @@ void Engine::HandlePeerFailure(PeerFailureReport report) {
     control_->BroadcastReconfig(info);
     ReconfigEndgame(info);
     return;
+  }
+  // Coordinator failover (docs/fault_tolerance.md "Coordinator failover"):
+  // the COORDINATOR died and a standby was announced at rendezvous.  The
+  // star topology means no survivor can broadcast a verdict (each worker
+  // only holds a socket to the dead coordinator), so every survivor
+  // independently synthesizes the IDENTICAL verdict from shared facts —
+  // the STANDBY announcement and the deterministic rank remap — and
+  // re-rendezvouses against the standby's pre-bound listener.  The epoch
+  // base is max(local, replicated): a standby whose replicated view ran
+  // ahead must never reuse an epoch across the succession.
+  if (opts_.elastic && !control_->is_coordinator() &&
+      report.failed_rank == 0 &&
+      opts_.size - 1 >= std::max(opts_.min_size, 1) &&
+      !shutdown_requested_.load()) {
+    StandbyInfo standby;
+    if (control_->GetStandby(&standby) && standby.standby_rank >= 1 &&
+        standby.standby_rank < opts_.size && standby.port > 0) {
+      int64_t epoch = opts_.epoch;
+      CoordState replicated;
+      if (control_->GetCoordState(&replicated) && replicated.epoch > epoch) {
+        epoch = replicated.epoch;
+      }
+      ReconfigInfo info;
+      info.epoch = epoch + 1;
+      info.new_size = opts_.size - 1;
+      info.failed_rank = 0;
+      info.cause =
+          report.cause.empty() ? "coordinator_failure" : report.cause;
+      // Deterministic remap: the standby becomes rank 0 (the engine's
+      // coordinator seat), everyone else fills 1..new_size-1 in old-rank
+      // order.  With the default standby (lowest rank) this is exactly
+      // the familiar r-1 shift.
+      info.new_ranks.assign(static_cast<size_t>(opts_.size), -1);
+      info.new_ranks[static_cast<size_t>(standby.standby_rank)] = 0;
+      int32_t next = 1;
+      for (int r = 1; r < opts_.size; ++r) {
+        if (r == standby.standby_rank) continue;
+        info.new_ranks[static_cast<size_t>(r)] = next++;
+      }
+      info.new_coord_rank = standby.standby_rank;
+      info.new_coord_host = standby.host;
+      info.new_coord_port = standby.port;
+      {
+        std::lock_guard<std::mutex> l(mu_);
+        failure_ = report;
+      }
+      std::fprintf(stderr,
+                   "NOTICE: horovod_tpu coordinator (rank 0) died (%s); "
+                   "promoting standby rank %d at %s:%d, epoch %lld\n",
+                   report.cause.c_str(), standby.standby_rank,
+                   standby.host.c_str(), standby.port,
+                   static_cast<long long>(info.epoch));
+      std::fflush(stderr);
+      if (timeline_.Initialized()) {
+        timeline_.Instant("control_plane", "COORDINATOR_FAILOVER");
+      }
+      ReconfigEndgame(info);
+      return;
+    }
+    // No standby was announced (non-elastic peers, bind failure): fall
+    // through to the structured abort — never hang.
   }
   AbortEndgame(std::move(report));
 }
@@ -694,6 +776,8 @@ void Engine::ReconfigEndgame(const ReconfigInfo& info) {
     resize_.new_size = info.new_size;
     resize_.failed_rank = info.failed_rank;
     resize_.cause = info.cause;
+    resize_.new_coord_host = info.new_coord_host;
+    resize_.new_coord_port = info.new_coord_port;
     // Coordinated flush, the PR-3 cache_clear semantics: the new
     // membership renegotiates everything from scratch — a cached verdict
     // sized for the old membership must never be served again.
@@ -779,6 +863,7 @@ bool Engine::MaybeHandleJoin() {
   ticket.epoch = info.epoch;
   ticket.new_size = info.new_size;
   ticket.assigned_rank = info.new_size - 1;
+  joins_admitted_.fetch_add(1);
   control_->SendJoinTicket(ticket);
   control_->BroadcastReconfig(info);
   ReconfigEndgame(info);
@@ -791,6 +876,12 @@ Engine::ResizeEventView Engine::ResizeEvent() {
 }
 
 void Engine::AckResize() { resize_acked_.store(true); }
+
+Engine::CoordStateView Engine::CoordStateReport() {
+  CoordStateView out;
+  if (control_ && control_->GetCoordState(&out.state)) out.present = true;
+  return out;
+}
 
 void Engine::DetachListener() {
   if (control_) control_->CloseListener();

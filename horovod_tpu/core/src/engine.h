@@ -187,9 +187,24 @@ class Engine {
     int32_t new_size = 0;
     int32_t failed_rank = -1;  // -1 for a grow (join)
     std::string cause;
+    // Coordinator failover: where the NEW membership's coordinator listens
+    // (empty host = the coordinator did not move).  Survivors re-form
+    // against this endpoint; the promoted standby re-binds new_coord_port.
+    std::string new_coord_host;
+    int32_t new_coord_port = 0;
   };
   ResizeEventView ResizeEvent();
   void AckResize();
+  // Failover observability (hvd.coord_state() in Python): the last
+  // coordinator-state delta this rank has seen — the coordinator's own
+  // emission on rank 0, the replicated copy on the standby, absent
+  // elsewhere.  Lets tests assert replication reached the standby before
+  // the coordinator was killed.
+  struct CoordStateView {
+    bool present = false;
+    CoordState state;
+  };
+  CoordStateView CoordStateReport();
   // Reconfiguration hand-off (coordinator): free the listen port for the
   // re-formed membership while keeping old peer sockets open — see
   // ControlPlane::CloseListener.
@@ -287,9 +302,17 @@ class Engine {
   PeerFailureReport failure_;                    // guarded by mu_
   ResizeEventView resize_;                       // guarded by mu_
   std::atomic<bool> resize_acked_{false};
-  int64_t verify_tick_ = 0;   // background thread only
+  // Cycle counter driving the verifier interval.  Atomic because the
+  // monitor thread reads it for standby state replication while the cycle
+  // thread increments it.
+  std::atomic<int64_t> verify_tick_{0};
   int64_t next_handle_ = 0;
   int64_t next_batch_id_ = 0;
+
+  // Grow reconfigurations admitted by this coordinator — replicated to the
+  // standby as part of CoordState (monitor thread reads, monitor thread
+  // writes; atomic for the hvd_coord_state test export).
+  std::atomic<int64_t> joins_admitted_{0};
 
   std::atomic<bool> shutdown_requested_{false};
   std::atomic<bool> stopped_{false};

@@ -329,6 +329,9 @@ void Serialize(const ReconfigInfo& in, std::string* out) {
   w.str(in.cause);
   w.i32(static_cast<int32_t>(in.new_ranks.size()));
   for (int32_t r : in.new_ranks) w.i32(r);
+  w.i32(in.new_coord_rank);
+  w.str(in.new_coord_host);
+  w.i32(in.new_coord_port);
 }
 
 bool Deserialize(const char* data, size_t len, ReconfigInfo* out) {
@@ -341,6 +344,9 @@ bool Deserialize(const char* data, size_t len, ReconfigInfo* out) {
   if (r.fail || n < 0 || static_cast<size_t>(n) > kMaxVector) return false;
   out->new_ranks.resize(n);
   for (int32_t i = 0; i < n; ++i) out->new_ranks[i] = r.i32();
+  out->new_coord_rank = r.i32();
+  out->new_coord_host = r.str();
+  out->new_coord_port = r.i32();
   return !r.fail;
 }
 
@@ -356,6 +362,44 @@ bool Deserialize(const char* data, size_t len, JoinTicket* out) {
   out->epoch = r.i64();
   out->new_size = r.i32();
   out->assigned_rank = r.i32();
+  return !r.fail;
+}
+
+void Serialize(const StandbyInfo& in, std::string* out) {
+  Writer w{out};
+  w.i32(in.standby_rank);
+  w.str(in.host);
+  w.i32(in.port);
+}
+
+bool Deserialize(const char* data, size_t len, StandbyInfo* out) {
+  Reader r{data, len};
+  out->standby_rank = r.i32();
+  out->host = r.str();
+  out->port = r.i32();
+  return !r.fail;
+}
+
+void Serialize(const CoordState& in, std::string* out) {
+  Writer w{out};
+  w.i64(in.epoch);
+  w.i64(in.joins_admitted);
+  w.i64(in.verify_checked);
+  w.i64(in.verify_tick);
+  w.i32(static_cast<int32_t>(in.lru_order.size()));
+  for (int32_t b : in.lru_order) w.i32(b);
+}
+
+bool Deserialize(const char* data, size_t len, CoordState* out) {
+  Reader r{data, len};
+  out->epoch = r.i64();
+  out->joins_admitted = r.i64();
+  out->verify_checked = r.i64();
+  out->verify_tick = r.i64();
+  int32_t n = r.i32();
+  if (r.fail || n < 0 || static_cast<size_t>(n) > kMaxVector) return false;
+  out->lru_order.resize(n);
+  for (int32_t i = 0; i < n; ++i) out->lru_order[i] = r.i32();
   return !r.fail;
 }
 

@@ -151,7 +151,8 @@ constexpr uint32_t kFrameMagic = 0x48564446;  // "FDVH" on the wire
 constexpr uint8_t kWireVersion = 1;
 
 enum class FrameType : uint8_t {
-  HELLO = 1,      // worker -> coordinator at connect: {i32 rank}
+  HELLO = 1,      // worker -> coordinator at connect: {i32 rank,
+                  // i32 standby_listen_port (0 = none pre-bound)}
   HELLO_ACK = 2,  // coordinator -> worker: empty = accepted, else error text
   REQUEST = 3,    // RequestList (worker -> coordinator, every cycle)
   RESPONSE = 4,   // ResponseList (coordinator -> workers)
@@ -161,6 +162,11 @@ enum class FrameType : uint8_t {
                   // workers; docs/fault_tolerance.md "In-place recovery")
   JOIN = 8,       // {i32 id}: a relaunched rank asking to be admitted
   JOIN_ACK = 9,   // JoinTicket: admission verdict for a JOIN
+  STANDBY = 10,   // StandbyInfo: coordinator -> workers after rendezvous —
+                  // the designated successor's pre-bound listen endpoint
+                  // (docs/fault_tolerance.md "Coordinator failover")
+  STATE = 11,     // CoordState: coordinator -> standby delta replication of
+                  // the authoritative-only coordinator state
 };
 
 // 16-byte little-endian header preceding every frame payload.  ``flags``
@@ -219,6 +225,14 @@ struct ReconfigInfo {
   // r's identity in the new membership, -1 when expelled.  A grow appends
   // the joiner at new_size - 1 (it learns that from its JoinTicket).
   std::vector<int32_t> new_ranks;
+  // Coordinator failover (docs/fault_tolerance.md "Coordinator failover"):
+  // when the COORDINATOR itself is the removed rank, the promoted standby's
+  // identity and pre-bound listen endpoint ride the verdict so survivors
+  // re-rendezvous without out-of-band discovery.  new_coord_rank is the
+  // standby's OLD rank; -1/empty/0 = the coordinator did not move.
+  int32_t new_coord_rank = -1;
+  std::string new_coord_host;
+  int32_t new_coord_port = 0;
 };
 
 void Serialize(const ReconfigInfo& in, std::string* out);
@@ -234,5 +248,39 @@ struct JoinTicket {
 
 void Serialize(const JoinTicket& in, std::string* out);
 bool Deserialize(const char* data, size_t len, JoinTicket* out);
+
+// Standby-coordinator designation (docs/fault_tolerance.md "Coordinator
+// failover"): broadcast to every worker in a STANDBY frame after the
+// rendezvous completes.  The standby is the lowest-ranked worker that
+// pre-bound a succession listener (HVD_TPU_STANDBY overrides the choice);
+// on coordinator death every survivor re-rendezvouses against host:port.
+struct StandbyInfo {
+  int32_t standby_rank = -1;  // -1 = no standby designated
+  std::string host;
+  int32_t port = 0;
+};
+
+void Serialize(const StandbyInfo& in, std::string* out);
+bool Deserialize(const char* data, size_t len, StandbyInfo* out);
+
+// Replicated authoritative-only coordinator state, streamed to the standby
+// in STATE frames by the coordinator's monitor thread.  Everything else a
+// promoted standby needs is already replicated by construction (the
+// response-cache slots mutate identically on every rank via the broadcast
+// protocol; membership rides RECONFIG); this carries the pieces only the
+// coordinator knows: the epoch it currently speaks, the join-admission
+// counter, the schedule verifier's interval position, and its private LRU
+// recency order (so a successor's future eviction decisions match the ones
+// the dead coordinator would have made).
+struct CoordState {
+  int64_t epoch = 0;
+  int64_t joins_admitted = 0;   // grow reconfigurations granted so far
+  int64_t verify_checked = 0;   // verifier: seqs matched and pruned
+  int64_t verify_tick = 0;      // verifier: interval phase (cycle count)
+  std::vector<int32_t> lru_order;  // cache bits, most recently used first
+};
+
+void Serialize(const CoordState& in, std::string* out);
+bool Deserialize(const char* data, size_t len, CoordState* out);
 
 }  // namespace hvd

@@ -51,9 +51,20 @@ int main(int argc, char** argv) {
   }
 
   // MakeCoordinator blocks until all workers connect, so the worker
-  // threads must exist first: pick a port up front (workers retry
-  // connecting inside MakeWorker's rendezvous budget).
-  int port = 23000 + (::getpid() % 2000);
+  // threads must exist first: reserve a free port up front (workers retry
+  // connecting inside MakeWorker's rendezvous budget).  Ask the OS via
+  // bind(0)+getsockname — a pid-derived guess collides when two benches
+  // (or a bench and a test suite) share a machine.  The reserving socket
+  // is closed before MakeCoordinator re-binds the port; the workers'
+  // connect-retry loop absorbs that instant.
+  int port = 0;
+  std::string bind_err;
+  int reserve_fd = hvd::TcpControlPlane::BindListener(&port, &bind_err);
+  if (reserve_fd < 0) {
+    std::fprintf(stderr, "port reservation failed: %s\n", bind_err.c_str());
+    return 2;
+  }
+  ::close(reserve_fd);
 
   std::vector<std::thread> workers;
   workers.reserve(static_cast<size_t>(p - 1));

@@ -28,10 +28,23 @@ The grow path is symmetric: the launcher (``python -m horovod_tpu.run
 a ``JOIN``/``JOIN_ACK`` handshake against the coordinator's listen socket
 — and is admitted at the next reconfiguration boundary with a fresh rank.
 
+Coordinator (rank 0) death no longer ends the job: every elastic worker
+pre-binds a standby listen socket and advertises it in its ``HELLO``; the
+coordinator names one survivor the *standby* (lowest advertised rank, or
+``HVD_TPU_STANDBY=<rank>``) in a post-rendezvous ``STANDBY`` broadcast and
+streams its authoritative state (epoch, admitted joins, verifier position,
+response-cache LRU order) to it in ``STATE`` frames each monitor tick.
+When the coordinator dies, every survivor detects it independently and
+synthesizes the *identical* reconfiguration verdict locally — the standby
+takes rank 0 on its pre-bound port, the rest renumber in old-rank order —
+so succession needs no out-of-band discovery.  The promoted coordinator
+publishes its endpoint to ``HVD_TPU_COORD_FILE`` (when set) so the
+launcher's single-rank relaunch can still find the job.
+
 Scope and floors: ``HVD_TPU_MIN_SIZE`` sets the size below which the old
-full-restart path (exit 75) still applies; coordinator (rank 0) death also
-falls back to full restart — coordinator failover is explicitly out of
-scope.  Reconfiguration itself is bounded by
+full-restart path (exit 75) still applies; a coordinator death with no
+announced standby (non-elastic boot, or every standby bind failed) also
+falls back to full restart.  Reconfiguration itself is bounded by
 ``HVD_TPU_RECONFIG_TIMEOUT_MS``: an unacknowledged resize, or a
 re-rendezvous that cannot complete, falls back to abort-and-restart, so
 nothing ever blocks forever (the PR-4 guarantee).
@@ -80,10 +93,21 @@ class ResizeEvent:
     new_size: int
     failed_rank: int  # -1 for a grow (a relaunched rank rejoined)
     cause: str
+    # Coordinator succession (failed_rank == 0): where the promoted standby
+    # listens.  Empty/0 for ordinary shrinks and grows — the coordinator
+    # did not move.
+    new_coord_host: str = ""
+    new_coord_port: int = 0
 
     @property
     def grew(self) -> bool:
         return self.new_size > self.old_size
+
+    @property
+    def coordinator_moved(self) -> bool:
+        """True when this event is a coordinator failover: a standby was
+        promoted and survivors must re-rendezvous at a new endpoint."""
+        return self.new_coord_port > 0
 
 
 class JoinTicket(NamedTuple):
@@ -177,10 +201,20 @@ def reconfigure(eng=None) -> ResizeEvent:
         # open through the re-rendezvous, or a survivor that has not yet
         # read the RECONFIG broadcast gets RST and its receive queue —
         # verdict included — is flushed (it would misread the shrink as
-        # coordinator death).
-        ctor["coordinator_port"] = eng.bound_port
+        # coordinator death).  Under a coordinator failover this rank is
+        # the promoted standby: its ``bound_port`` is the standby listen
+        # socket it pre-bound at HELLO time (== ``ev.new_coord_port``), and
+        # detach_listener() releases that socket so MakeCoordinator can
+        # re-bind the very port the other survivors are already dialing.
+        ctor["coordinator_port"] = ev.new_coord_port or eng.bound_port
         eng.detach_listener()
     else:
+        if ev.coordinator_moved:
+            # Coordinator succession: re-rendezvous at the promoted
+            # standby's pre-announced endpoint, not the dead rank 0's.
+            ctor["coordinator_host"] = ev.new_coord_host or ctor.get(
+                "coordinator_host", "127.0.0.1")
+            ctor["coordinator_port"] = ev.new_coord_port
         eng.shutdown()
     # The verifier's rolling hash restarts with the new membership (the
     # native coordinator's streams are rebuilt from scratch).
@@ -211,10 +245,72 @@ def reconfigure(eng=None) -> ResizeEvent:
     from horovod_tpu import basics as _basics
 
     _basics._apply_resize(ev.new_rank, ev.new_size)
+    if ev.new_rank == 0:
+        # The (possibly newly promoted) coordinator republishes its
+        # endpoint so late joiners and the launcher's single-rank relaunch
+        # can find the job even after a succession moved rank 0.
+        _publish_coordinator(
+            ev.new_coord_host
+            or ctor.get("coordinator_host")
+            or os.environ.get("HVD_TPU_COORDINATOR_HOST", "127.0.0.1"),
+            new_eng.bound_port or ev.new_coord_port, ev.epoch)
     _last_event = ev
     for cb in _callbacks:
         cb(ev)
     return ev
+
+
+def _publish_coordinator(host: str, port: int, epoch: int) -> None:
+    """Atomically record the active coordinator endpoint in
+    ``HVD_TPU_COORD_FILE`` (no-op when the env var is unset).  Written by
+    whichever rank currently holds rank 0 — at first rendezvous by the
+    launcher, and again by the promoted standby after a failover."""
+    path = os.environ.get("HVD_TPU_COORD_FILE")
+    if not path or port <= 0:
+        return
+    try:
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(f"{host} {port} {epoch}\n")
+        os.replace(tmp, path)
+    except OSError:
+        pass  # best-effort: the env-var endpoint still works pre-failover
+
+
+def _read_coord_file() -> tuple[str, int] | None:
+    """``(host, port)`` from ``HVD_TPU_COORD_FILE``, or ``None`` when the
+    env var is unset or the file is absent/unparseable."""
+    path = os.environ.get("HVD_TPU_COORD_FILE")
+    if not path:
+        return None
+    try:
+        with open(path) as f:
+            parts = f.read().split()
+        if len(parts) >= 2 and int(parts[1]) > 0:
+            return parts[0], int(parts[1])
+    except (OSError, ValueError):
+        pass
+    return None
+
+
+def coordinator_endpoint(
+        default_host: str = "127.0.0.1",
+        default_port: int = 0) -> tuple[str, int]:
+    """The job's current coordinator endpoint: ``HVD_TPU_COORD_FILE``
+    (kept current across coordinator failovers) when set and readable,
+    else ``HVD_TPU_COORDINATOR_HOST``/``HVD_TPU_COORDINATOR_PORT``, else
+    the supplied defaults.  :func:`join` re-reads this every retry, so a
+    rejoin that races a succession converges on the new coordinator."""
+    published = _read_coord_file()
+    if published is not None:
+        return published
+    host = os.environ.get("HVD_TPU_COORDINATOR_HOST", default_host)
+    try:
+        port = int(os.environ.get("HVD_TPU_COORDINATOR_PORT", "") or
+                   default_port)
+    except ValueError:
+        port = default_port
+    return host, port
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -240,7 +336,12 @@ def join(host: str, port: int, *, old_rank: int = -1,
     Returns the :class:`JoinTicket` naming the epoch, size, and rank to
     rendezvous with; create the engine from it and restore from the last
     complete checkpoint like any other member.  Bounded by ``timeout_s``
-    (default: the rendezvous budget, ``HVD_TPU_CONNECT_TIMEOUT``)."""
+    (default: the rendezvous budget, ``HVD_TPU_CONNECT_TIMEOUT``).
+
+    When ``HVD_TPU_COORD_FILE`` is set, each retry re-reads the published
+    endpoint, so a joiner that raced a coordinator failover converges on
+    the promoted standby instead of knocking forever on the dead rank 0's
+    port."""
     budget = timeout_s
     if budget is None:
         budget = float(os.environ.get("HVD_TPU_CONNECT_TIMEOUT", "300") or 300)
@@ -248,9 +349,11 @@ def join(host: str, port: int, *, old_rank: int = -1,
     delay = 0.05
     last_err: Exception | None = None
     while time.monotonic() < deadline:
+        published = _read_coord_file()
+        dial = published if published is not None else (host, port)
         sock = None
         try:
-            sock = socket.create_connection((host, port), timeout=2.0)
+            sock = socket.create_connection(dial, timeout=2.0)
             payload = struct.pack("<i", old_rank)
             sock.sendall(struct.pack(
                 "<IBBHII", _FRAME_MAGIC, _WIRE_VERSION, _FRAME_JOIN, 0,
@@ -274,7 +377,9 @@ def join(host: str, port: int, *, old_rank: int = -1,
         finally:
             if sock is not None:
                 sock.close()
+    published = _read_coord_file()
+    dial = published if published is not None else (host, port)
     raise TimeoutError(
-        f"could not rejoin the job at {host}:{port} within {budget:.0f}s "
-        f"(last error: {last_err}); is the coordinator running with "
-        f"HVD_TPU_ELASTIC=1?")
+        f"could not rejoin the job at {dial[0]}:{dial[1]} within "
+        f"{budget:.0f}s (last error: {last_err}); is the coordinator "
+        f"running with HVD_TPU_ELASTIC=1?")

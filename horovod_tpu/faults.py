@@ -39,6 +39,18 @@ Injectors (all opt-in; absent env == no faults):
   shrink, or a structured ``hvd.failure_report()`` abort within the
   heartbeat bound — never a hang (tests/test_failure_detection.py
   chaos soaks).
+
+  **Coordinator-targeted plans** (``"0[:<frame>]"``, or
+  ``HVD_TPU_FAULT_KILL_RANK=0``) are the coordinator-failover drill
+  (docs/fault_tolerance.md "Coordinator failover"): with
+  ``HVD_TPU_ELASTIC=1`` the survivors promote the announced standby to
+  rank 0 and shrink, instead of the whole job restarting.  For the
+  non-fatal wire faults (DROP/PARTITION) note the split-brain shape: the
+  old coordinator process stays ALIVE but isolated, so run such soaks
+  with ``HVD_TPU_MIN_SIZE=2`` (3 ranks) — the two real survivors shrink
+  to 2 while the isolated ex-coordinator, unable to reach a quorum above
+  the floor, takes the structured exit-75 abort
+  (tests/test_elastic_reconfig.py coordinator chaos soak).
 * ``HVD_TPU_FAULT_ON_ATTEMPT`` (default 0) — faults fire only when the
   launcher-exported ``HVD_TPU_RESTART_ATTEMPT`` matches, so an injected
   crash consumes exactly one restart and the relaunched job runs clean.

@@ -54,6 +54,7 @@ import signal
 import socket
 import subprocess
 import sys
+import tempfile
 import threading
 import time
 
@@ -86,13 +87,19 @@ def _pump(stream, rank: int, tag: bool, lock: threading.Lock) -> None:
 
 def _child_env(rank: int, np_: int, jax_port: int, coord_port: int,
                platform: str | None, attempt: int,
-               resume_dir: str | None, join: bool = False) -> dict[str, str]:
+               resume_dir: str | None, join: bool = False,
+               coord_file: str | None = None) -> dict[str, str]:
     env = dict(os.environ)
     env["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{jax_port}"
     env["JAX_NUM_PROCESSES"] = str(np_)
     env["JAX_PROCESS_ID"] = str(rank)
     env["HVD_TPU_COORDINATOR_HOST"] = "127.0.0.1"
     env["HVD_TPU_COORDINATOR_PORT"] = str(coord_port)
+    if coord_file:
+        # Failover-aware rendezvous: whichever rank holds the coordinator
+        # seat republishes its endpoint here (elastic._publish_coordinator),
+        # so joiners racing a standby promotion converge on the successor.
+        env["HVD_TPU_COORD_FILE"] = coord_file
     env.setdefault("HVD_TPU_EXECUTOR", "multihost")
     env["HVD_TPU_RESTART_ATTEMPT"] = str(attempt)
     if join:
@@ -164,10 +171,31 @@ def _run_once(command: list[str], args, attempt: int,
     Single-rank relaunches are accounted in ``stats`` separately from
     full-job restarts; a relaunched rank that later exits cleanly marks
     ``rejoin_success`` so the supervisor's crash-loop breaker resets.
-    Rank-0 death keeps the mpirun job-abort contract (coordinator
-    failover is out of scope)."""
+    Rank-0 death is covered too: the in-job standby promotes itself to
+    coordinator and republishes the endpoint in ``HVD_TPU_COORD_FILE``, so
+    the launcher relaunches the dead seat as a joiner against whichever
+    process now holds rank 0 (docs/fault_tolerance.md "Coordinator
+    failover")."""
     stats = stats if stats is not None else {}
     jax_port, coord_port = _free_port(), _free_port()
+    elastic = bool(getattr(args, "elastic", False))
+    # The coordinator-endpoint file: seeded with rank 0's initial address,
+    # rewritten by the promoted standby after a failover.  An inherited
+    # HVD_TPU_COORD_FILE is respected (multi-launcher setups); otherwise an
+    # elastic job gets a private one for its lifetime.
+    coord_file = os.environ.get("HVD_TPU_COORD_FILE") or None
+    own_coord_file = False
+    if elastic and coord_file is None:
+        fd, coord_file = tempfile.mkstemp(prefix="hvd_coord_",
+                                          suffix=".addr")
+        os.close(fd)
+        own_coord_file = True
+    if elastic and coord_file:
+        try:
+            with open(coord_file, "w") as f:
+                f.write(f"127.0.0.1 {coord_port} 0\n")
+        except OSError:
+            pass
     procs: list[subprocess.Popen] = []
     pumps: list[threading.Thread] = []
     try:
@@ -175,7 +203,8 @@ def _run_once(command: list[str], args, attempt: int,
             p = subprocess.Popen(
                 command,
                 env=_child_env(rank, args.np_, jax_port, coord_port,
-                               args.platform or None, attempt, resume_dir),
+                               args.platform or None, attempt, resume_dir,
+                               coord_file=coord_file if elastic else None),
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                 start_new_session=True)
             procs.append(p)
@@ -197,8 +226,27 @@ def _run_once(command: list[str], args, attempt: int,
     exit_code = 0
     remaining = set(range(args.np_))
     drain_deadline: float | None = None
+
+    def _coordinator_reachable(dead_rank: int) -> bool:
+        """Whether somebody can still admit a rejoin.  True while the
+        original rank 0 lives; after rank 0's own death, true either for
+        rank 0's seat itself (the standby's promotion is in flight — the
+        joiner's retry loop absorbs the window) or once the promoted
+        standby has republished the endpoint with a bumped epoch."""
+        if 0 in remaining:
+            return True
+        if dead_rank == 0:
+            return bool(remaining)
+        if coord_file:
+            try:
+                with open(coord_file) as f:
+                    parts = f.read().split()
+                return len(parts) >= 3 and int(parts[2]) > 0
+            except (OSError, ValueError):
+                pass
+        return False
+
     # Elastic single-rank relaunch state (see docstring).
-    elastic = bool(getattr(args, "elastic", False))
     relaunch_counts: dict[int, int] = {}
     relaunched: set[int] = set()
     relaunch_backoff = Backoff(
@@ -238,11 +286,15 @@ def _run_once(command: list[str], args, attempt: int,
                     # to clean completion.  The supervisor's crash-loop
                     # breaker resets on this (main()).
                     stats["rejoin_success"] = True
-                if rc != 0 and elastic and r != 0 and 0 in remaining \
+                if rc != 0 and elastic and remaining \
+                        and _coordinator_reachable(r) \
                         and not stop.event.is_set() and exit_code == 0:
                     # Elastic grow path: survivors shrank in place; bring
-                    # ONLY this rank back and let it JOIN.  Per-rank cap so
-                    # a rank that can never rejoin still aborts the job.
+                    # ONLY this rank back and let it JOIN.  Rank 0's seat
+                    # qualifies too — the standby promotes in-job and the
+                    # joiner finds it through HVD_TPU_COORD_FILE.  Per-rank
+                    # cap so a rank that can never rejoin still aborts the
+                    # job.
                     spent = relaunch_counts.get(r, 0)
                     if spent < max(args.max_restarts, 1):
                         delay = relaunch_backoff.delay(spent)
@@ -269,7 +321,8 @@ def _run_once(command: list[str], args, attempt: int,
                             env=_child_env(r, args.np_, jax_port, coord_port,
                                            args.platform or None,
                                            attempt + relaunch_counts[r],
-                                           resume_dir, join=True),
+                                           resume_dir, join=True,
+                                           coord_file=coord_file),
                             stdout=subprocess.PIPE,
                             stderr=subprocess.STDOUT,
                             start_new_session=True)
@@ -318,6 +371,11 @@ def _run_once(command: list[str], args, attempt: int,
         for t in pumps:
             t.join(timeout=2.0)
         _current_procs[:] = []
+        if own_coord_file and coord_file:
+            try:
+                os.unlink(coord_file)
+            except OSError:
+                pass
     return exit_code
 
 
@@ -362,11 +420,11 @@ def main(argv: list[str] | None = None) -> int:
                              "SIGKILL escalation (default 30)")
     parser.add_argument("--elastic", action="store_true",
                         help="in-place elastic recovery (implied by "
-                             "HVD_TPU_ELASTIC=1): a dead non-coordinator "
-                             "rank is relaunched ALONE with "
-                             "HVD_TPU_ELASTIC_JOIN=1 and rejoins the "
-                             "surviving, still-running job; rank-0 death "
-                             "keeps the full-restart path "
+                             "HVD_TPU_ELASTIC=1): a dead rank is relaunched "
+                             "ALONE with HVD_TPU_ELASTIC_JOIN=1 and rejoins "
+                             "the surviving, still-running job; rank-0 "
+                             "death promotes the in-job standby and the "
+                             "dead seat rejoins via HVD_TPU_COORD_FILE "
                              "(docs/fault_tolerance.md)")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="program and arguments (e.g. python train.py)")

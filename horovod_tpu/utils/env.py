@@ -63,6 +63,17 @@ aliases; the TPU-specific defaults differ where the hardware does:
   recovery").
 * ``HVD_TPU_MIN_SIZE`` — survivor-count floor (default 1) below which an
   elastic job falls back to the legacy exit-75 full restart.
+* ``HVD_TPU_STANDBY`` — pin the coordinator-failover standby to a specific
+  rank (default: the lowest non-coordinator rank that advertised a standby
+  listen port in its HELLO).  The coordinator streams its authoritative
+  state to the standby each monitor tick; on coordinator death the standby
+  promotes itself to rank 0 on its pre-announced port and the survivors
+  re-rendezvous there (docs/fault_tolerance.md "Coordinator failover").
+* ``HVD_TPU_COORD_FILE`` — path where the ACTIVE coordinator publishes its
+  ``host port epoch`` endpoint (exported automatically by ``python -m
+  horovod_tpu.run --elastic``).  ``elastic.join`` re-reads it every retry,
+  so a relaunched rank finds the promoted standby after a succession
+  instead of knocking on the dead rank 0's port forever.
 * ``HVD_TPU_RECONFIG_TIMEOUT_MS`` — bound (default 30000) on in-place
   reconfiguration (resize acknowledgement + re-rendezvous); expiry falls
   back to abort-and-restart, keeping the nothing-blocks-forever guarantee.
@@ -221,6 +232,22 @@ def min_size() -> int:
     return int(raw) if raw not in (None, "") else DEFAULT_MIN_SIZE
 
 
+def standby_rank() -> int:
+    """``HVD_TPU_STANDBY`` — pinned coordinator-failover standby rank, or
+    -1 for the default policy (lowest non-coordinator rank that advertised
+    a standby listen port).  Read natively in core/src/controller.cc; this
+    accessor exists for tests and tooling.  Malformed values degrade to the
+    default policy — same contract as :func:`overlap_buckets`."""
+    raw = _get("STANDBY")
+    if raw in (None, ""):
+        return -1
+    try:
+        value = int(raw)
+        return value if value >= 1 else -1
+    except ValueError:
+        return -1
+
+
 def reconfig_timeout_ms() -> float:
     """``HVD_TPU_RECONFIG_TIMEOUT_MS`` — bound (default 30000) on the
     whole in-place reconfiguration: an unacknowledged resize event, or a
@@ -243,6 +270,28 @@ def overlap_buckets() -> int:
     (reference horovod/common/operations.cc:203-216,
     horovod/torch/__init__.py:83-112); pair with
     ``hvd.overlap_compiler_options()`` at jit time for async execution
-    (ops/collective_ops.py:_chained_allreduce, examples/overlap_audit.py)."""
+    (ops/collective_ops.py:_chained_allreduce, examples/overlap_audit.py).
+
+    A malformed value (non-integer, or negative) falls back to the default
+    with a warning instead of crashing the job at its first compiled step —
+    launch-script typos in a knob this deep in the stack should degrade,
+    not abort."""
     raw = _get("OVERLAP_BUCKETS")
-    return int(raw) if raw else DEFAULT_OVERLAP_BUCKETS
+    if not raw:
+        return DEFAULT_OVERLAP_BUCKETS
+    try:
+        value = int(raw)
+        if value < 0:
+            raise ValueError("negative bucket count")
+    except ValueError:
+        import warnings
+
+        name = ("HOROVOD_OVERLAP_BUCKETS"
+                if "HOROVOD_OVERLAP_BUCKETS" in os.environ
+                else "HVD_TPU_OVERLAP_BUCKETS")
+        warnings.warn(
+            f"{name}={raw!r} is not a non-negative integer; falling back "
+            f"to the default ({DEFAULT_OVERLAP_BUCKETS})",
+            RuntimeWarning, stacklevel=2)
+        return DEFAULT_OVERLAP_BUCKETS
+    return value

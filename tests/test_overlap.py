@@ -99,6 +99,39 @@ def test_disabling_chain_restores_single_merged_all_reduce(monkeypatch):
     assert audit["all_reduces_before_last_backward"] == 0, audit
 
 
+def test_overlap_buckets_malformed_env_falls_back_with_warning(monkeypatch):
+    # A launch-script typo in the bucket knob must degrade to the default
+    # with a warning naming the offending env var — not crash the job at
+    # its first compiled step.
+    import warnings
+
+    from horovod_tpu.utils import env
+
+    monkeypatch.delenv("HOROVOD_OVERLAP_BUCKETS", raising=False)
+    monkeypatch.setenv("HVD_TPU_OVERLAP_BUCKETS", "fourish")
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        assert env.overlap_buckets() == env.DEFAULT_OVERLAP_BUCKETS
+    assert any("HVD_TPU_OVERLAP_BUCKETS" in str(w.message) for w in caught)
+
+    # The HOROVOD_* spelling wins the lookup and is named in the warning.
+    monkeypatch.setenv("HOROVOD_OVERLAP_BUCKETS", "-3")
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        assert env.overlap_buckets() == env.DEFAULT_OVERLAP_BUCKETS
+    assert any("HOROVOD_OVERLAP_BUCKETS" in str(w.message) for w in caught)
+
+
+def test_overlap_buckets_well_formed_env_still_parses(monkeypatch):
+    monkeypatch.delenv("HOROVOD_OVERLAP_BUCKETS", raising=False)
+    monkeypatch.setenv("HVD_TPU_OVERLAP_BUCKETS", "7")
+    from horovod_tpu.utils import env
+
+    assert env.overlap_buckets() == 7
+    monkeypatch.setenv("HVD_TPU_OVERLAP_BUCKETS", "0")
+    assert env.overlap_buckets() == 0
+
+
 def test_overlap_compiler_options_shape():
     # Off-TPU the dict must be empty (other compile paths reject unknown
     # keys); the TPU dict pins the exact flag set the audit measured.
