@@ -19,14 +19,29 @@ Two phases, one JSON metric line each:
    of 10 batches each, reporting mean images/sec::
 
        {"metric": "resnet50_synthetic_train_throughput", "value": N,
-        "unit": "img/s/chip", "vs_baseline": N}
+        "unit": "img/s/chip", "vs_baseline": N,
+        "overlap_plan": {...}}
 
    ``vs_baseline`` divides by the only per-device figure the reference
    publishes (docs/benchmarks.md:34-38: ResNet-101, 1656.82 img/s on 16
    Pascal GPUs = 103.55 img/s/GPU; hardware era differs — the ratio is
    recorded for trend tracking, not as a same-silicon comparison).
+   ``overlap_plan`` is the schedule planner's decision for the traced
+   step (``hvd.overlap_plan()``, ops/schedule_plan.py) — the headline
+   number is meaningless without knowing whether the bucket chain was
+   engaged, at what depth, and why.
 
-``BENCH_SKIP_EAGER=1`` / ``BENCH_SKIP_RESNET=1`` run one phase alone.
+2b. **Width-1 overlap-plan microbench** — lowers a small training step
+   over a ONE-device mesh and asserts the adaptive planner bypassed the
+   dependency chain (zero gate ops in the stablehlo; the r5 −4.3%
+   single-chip ResNet regression, pinned in the harness itself)::
+
+       {"metric": "overlap_width1_chain_gates", "value": 0, "unit": "ops",
+        "vs_baseline": <gates the r5 static default emitted>,
+        "plan": {...}}
+
+``BENCH_SKIP_EAGER=1`` / ``BENCH_SKIP_RESNET=1`` / ``BENCH_SKIP_PLAN=1``
+skip individual phases.
 
 3. **Fault-detection MTTR** (``bench.py --fault``) — two-process engine
    job; rank 1 is SIGKILLed at steady state and the survivor's
@@ -354,6 +369,42 @@ def elastic_bench() -> None:
     }))
 
 
+def overlap_plan_microbench() -> None:
+    """Width-1 planner check, in the harness where the regression lived:
+    lower a small training step over a ONE-device mesh and assert the
+    adaptive planner bypassed the bucket chain — zero ``is_finite`` gate
+    ops in the stablehlo (the chain's anti-combining gate is the lowered
+    program's only source of that op).  The r5 static default emitted
+    depth−1 of them at width 1 and cost −4.3% on the single-chip ResNet
+    headline; this line keeps that structurally impossible to ship."""
+    import horovod_tpu as hvd
+    from horovod_tpu.utils import env as hvd_env
+
+    hvd.init()
+    # Measure the ADAPTIVE default: ambient bucket overrides route to the
+    # StaticPlanner, which chains regardless of width by contract.
+    saved = {v: os.environ.pop(v, None)
+             for v in ("HOROVOD_OVERLAP_BUCKETS", "HVD_TPU_OVERLAP_BUCKETS")}
+    try:
+        from examples.overlap_audit import audit_cpu_sim_width1
+
+        audit = audit_cpu_sim_width1()
+    finally:
+        for v, val in saved.items():
+            if val is not None:
+                os.environ[v] = val
+    gates, plan = audit["gate_is_finite_ops"], audit["plan"]
+    assert gates == 0 and plan is not None and not plan["chained"], (
+        "width-1 lowering still carries the bucket chain", audit)
+    print(json.dumps({
+        "metric": "overlap_width1_chain_gates",
+        "value": gates,
+        "unit": "ops",
+        "vs_baseline": hvd_env.DEFAULT_OVERLAP_BUCKETS - 1,
+        "plan": plan,
+    }))
+
+
 def main() -> None:
     if "--fault" in sys.argv:
         if "--elastic" in sys.argv:
@@ -363,6 +414,8 @@ def main() -> None:
         return
     if os.environ.get("BENCH_SKIP_EAGER") != "1":
         eager_microbench()
+    if os.environ.get("BENCH_SKIP_PLAN") != "1":
+        overlap_plan_microbench()
     if os.environ.get("BENCH_SKIP_RESNET") == "1":
         return
     import jax
@@ -470,6 +523,9 @@ def main() -> None:
         "value": round(per_chip, 2),
         "unit": "img/s/chip",
         "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC_PER_DEVICE, 3),
+        # The planner's decision for the step just timed — a throughput
+        # number is uninterpretable without the chain depth behind it.
+        "overlap_plan": hvd.overlap_plan(),
     }))
 
 

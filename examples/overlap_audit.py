@@ -36,6 +36,20 @@ Measured results:
   ``optimization_barrier`` chaining is stripped by the TPU pipeline —
   the arithmetic gate is load-bearing.  The scaling projection keeps its
   zero-overlap column as the conservative floor.
+* Round 9: the chain is no longer unconditional — a trace-time schedule
+  planner (ops/schedule_plan.py) decides per program.  This harness now
+  audits BOTH planner branches: :func:`audit_cpu_sim` lowers at the sim
+  mesh's real width (the chain engages, ``gate_is_finite_ops`` > 0) and
+  :func:`audit_cpu_sim_width1` lowers the same step on a 1-device mesh
+  (the adaptive planner bypasses the chain — zero gates, the round-4
+  free-combining structure).  ``--assert-planner`` runs both and exits
+  nonzero on any regression (wired into ``make ci``).
+
+Each audit dict carries ``plan`` — the ``hvd.overlap_plan()`` decision
+recorded while the step traced — and ``gate_is_finite_ops``, the count of
+``is_finite`` gate ops in the lowered stablehlo (the chain's arithmetic
+gate is the only source of ``is_finite`` in this model, so the count is a
+direct structural probe of chain presence).
 """
 
 from __future__ import annotations
@@ -115,9 +129,44 @@ def audit_cpu_sim() -> dict:
                                   hvd.batch_spec(1)),
                         out_specs=(P(), P()))
     lowered = jax.jit(sharded).lower(params, opt_state, x, y)
-    pre = lowered.as_text().count("all_reduce")
+    stablehlo = lowered.as_text()
     out = audit_text(lowered.compile().as_text())
-    out["stablehlo_all_reduces"] = pre
+    out["stablehlo_all_reduces"] = stablehlo.count("all_reduce")
+    out["gate_is_finite_ops"] = stablehlo.count("is_finite")
+    out["plan"] = hvd.overlap_plan()
+    return out
+
+
+def audit_cpu_sim_width1() -> dict:
+    """The same step lowered over a ONE-device mesh: data width 1, where
+    ``psum`` is identity — the adaptive planner must bypass the chain
+    (zero ``is_finite`` gates, the round-4 free-combining structure) so
+    single-chip runs stop paying for overlap that cannot exist (the r5
+    −4.3% ResNet headline regression this planner retires)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    model, opt, step = build_step()
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("hvd",))
+    sharded = shard_map(step, mesh=mesh,
+                        in_specs=(P(), P(), P("hvd"), P("hvd")),
+                        out_specs=(P(), P()), check_rep=False)
+    x = jnp.zeros((16, 1024))
+    y = jnp.zeros((16,), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), x)
+    opt_state = opt.init(params)
+    lowered = jax.jit(sharded).lower(params, opt_state, x, y)
+    stablehlo = lowered.as_text()
+    out = audit_text(lowered.compile().as_text())
+    out["stablehlo_all_reduces"] = stablehlo.count("all_reduce")
+    out["gate_is_finite_ops"] = stablehlo.count("is_finite")
+    out["plan"] = hvd.overlap_plan()
     return out
 
 
@@ -154,23 +203,84 @@ def audit_tpu_topology(topology: str = "v5e:2x4",
     ys = jax.ShapeDtypeStruct((64,), jnp.int32,
                               sharding=NamedSharding(mesh, P("hvd")))
     lowered = jax.jit(sharded).lower(ps, os_, xs, ys)
-    pre = lowered.as_text().count("all_reduce")
+    stablehlo = lowered.as_text()
     out = audit_text(lowered.compile().as_text()
                      if compiler_options is None else
                      lowered.compile(compiler_options=compiler_options)
                      .as_text())
-    out["stablehlo_all_reduces"] = pre
+    out["stablehlo_all_reduces"] = stablehlo.count("all_reduce")
+    out["gate_is_finite_ops"] = stablehlo.count("is_finite")
+    import horovod_tpu as hvd
+
+    out["plan"] = hvd.overlap_plan()
     out["topology"] = topology
     return out
 
 
+def assert_planner() -> int:
+    """CI gate (``make ci`` overlap-audit leg): lower BOTH planner
+    branches on the CPU sim and fail loudly on any regression —
+
+    * at the sim mesh's real width the adaptive default must keep the
+      depth-4 chain (gates present, >= DEFAULT_OVERLAP_BUCKETS surviving
+      all-reduces);
+    * at width 1 it must bypass the chain entirely (zero gates — the
+      free-combining structure, so single-chip runs never pay for it).
+
+    Runs deviceless: ambient bucket overrides are stripped first (the
+    gate audits the SHIPPED default, not the local shell).
+    """
+    import os
+
+    for v in ("HOROVOD_OVERLAP_BUCKETS", "HVD_TPU_OVERLAP_BUCKETS"):
+        os.environ.pop(v, None)
+    from horovod_tpu.utils import env as _env
+
+    wide = audit_cpu_sim()
+    w1 = audit_cpu_sim_width1()
+    failures = []
+    plan_wide, plan_w1 = wide["plan"], w1["plan"]
+    if not (plan_wide and plan_wide["chained"]
+            and plan_wide["chain_depth"] == _env.DEFAULT_OVERLAP_BUCKETS
+            and plan_wide["planner"] == "adaptive"):
+        failures.append(f"width>1 plan lost the default chain: {plan_wide}")
+    if wide["gate_is_finite_ops"] == 0:
+        failures.append("width>1 lowering carries no chain gates")
+    if wide["all_reduce_ops"] < _env.DEFAULT_OVERLAP_BUCKETS:
+        failures.append(
+            f"chained all-reduces merged: {wide['all_reduce_ops']} survive")
+    if not (plan_w1 and not plan_w1["chained"]
+            and plan_w1["chain_depth"] == 0
+            and plan_w1["planner"] == "adaptive"):
+        failures.append(f"width-1 plan failed to bypass the chain: {plan_w1}")
+    if w1["gate_is_finite_ops"] != 0:
+        failures.append(
+            f"width-1 lowering still carries {w1['gate_is_finite_ops']} "
+            f"chain gates — the r5 regression structure")
+    print(json.dumps({"cpu_sim": wide, "cpu_sim_width1": w1,
+                      "failures": failures}, indent=1))
+    return 1 if failures else 0
+
+
 def main():
+    import os
+
+    if "jax" not in sys.modules and "xla_force_host_platform_device_count" \
+            not in os.environ.get("XLA_FLAGS", ""):
+        # Standalone-script runs (the make ci overlap-audit leg) need a
+        # multi-device CPU sim for the width>1 branch; under pytest the
+        # conftest forces the same 8-device count.
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " --xla_force_host_platform_device_count=8")
     import jax
 
+    if "--assert-planner" in sys.argv:
+        return assert_planner()
     results = {}
     platform = jax.default_backend()
     if platform == "cpu":
         results["cpu_sim"] = audit_cpu_sim()
+        results["cpu_sim_width1"] = audit_cpu_sim_width1()
     else:
         # The constant, not overlap_compiler_options(): the deviceless AOT
         # compile targets TPU regardless of this host's default backend,
@@ -188,4 +298,11 @@ def main():
 
 
 if __name__ == "__main__":
+    import os as _os
+
+    # Script entry (make ci runs `python examples/overlap_audit.py`): put
+    # the repo root ahead of the script dir so `import horovod_tpu` works
+    # without an install.
+    sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+        _os.path.abspath(__file__))))
     sys.exit(main())

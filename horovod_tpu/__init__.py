@@ -52,11 +52,14 @@ for _mod, _names in {
         "replicated_sharding",
     ),
     "horovod_tpu.ops": (
-        "Compression", "allgather", "allgather_async", "allreduce",
+        "AdaptivePlanner", "BucketPlan", "Compression", "GradientManifest",
+        "Planner", "StaticPlanner", "allgather", "allgather_async",
+        "allreduce",
         "allreduce_async", "allreduce_sparse", "alltoall", "alltoall_async",
         "barrier", "batch_spec", "broadcast", "broadcast_async",
         "flash_attention", "grouped_allreduce", "make_flash_attention",
-        "overlap_compiler_options", "poll", "quantized_grouped_allreduce",
+        "overlap_compiler_options", "overlap_plan", "poll",
+        "quantized_grouped_allreduce",
         "shard",
         "softmax_cross_entropy", "sparse_to_dense", "synchronize",
     ),

@@ -128,6 +128,9 @@ def _load_library() -> ctypes.CDLL:
     lib.hvd_batch_activity.restype = None
     lib.hvd_batch_activity.argtypes = [ctypes.c_void_p, ctypes.c_longlong,
                                        ctypes.c_char_p]
+    lib.hvd_timeline_instant.restype = None
+    lib.hvd_timeline_instant.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                         ctypes.c_char_p]
     lib.hvd_stall_report.restype = ctypes.c_int
     lib.hvd_stall_report.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                      ctypes.c_int]
@@ -715,6 +718,16 @@ class NativeEngine:
         if not self._timeline_enabled:
             return
         self._lib.hvd_batch_activity(self._ptr, batch.id, activity.encode())
+
+    def timeline_instant(self, row: str, label: str) -> None:
+        """Instant marker on a named timeline row — the OVERLAP_PLAN
+        schedule-planner decisions (ops/schedule_plan.py) land alongside
+        the dispatch loop's CACHE_HIT/NEGOTIATED instants; no-op without
+        a timeline."""
+        if not self._timeline_enabled:
+            return
+        self._lib.hvd_timeline_instant(self._ptr, row.encode(),
+                                       label.encode())
 
     def take_inputs(self, batch: ExecBatch) -> list[np.ndarray]:
         with self._store_lock:

@@ -169,6 +169,14 @@ void hvd_batch_activity(void* e, long long batch_id, const char* activity) {
                                          activity ? activity : "");
 }
 
+// Instant marker on a named timeline row (no batch needed) — the
+// OVERLAP_PLAN schedule-planner instants ride the same surface as the
+// dispatch loop's CACHE_HIT/NEGOTIATED markers.
+void hvd_timeline_instant(void* e, const char* row, const char* label) {
+  static_cast<Engine*>(e)->TimelineInstant(row ? row : "",
+                                           label ? label : "");
+}
+
 void hvd_batch_done(void* e, long long batch_id, int status,
                     const char* reason) {
   Status s;

@@ -480,6 +480,13 @@ void Engine::BatchActivity(int64_t batch_id, const std::string& activity) {
   }
 }
 
+void Engine::TimelineInstant(const std::string& row,
+                             const std::string& label) {
+  std::lock_guard<std::mutex> l(mu_);
+  if (!timeline_.Initialized()) return;
+  timeline_.Instant(row, label);
+}
+
 void Engine::BatchDone(int64_t batch_id, const Status& status) {
   std::lock_guard<std::mutex> l(mu_);
   auto it = executing_.find(batch_id);

@@ -138,6 +138,11 @@ class Engine {
   // operations.cc:698-710: QUEUE, MEMCPY_IN_FUSION_BUFFER, <collective>,
   // MEMCPY_OUT_FUSION_BUFFER).  No-op when the timeline is disabled.
   void BatchActivity(int64_t batch_id, const std::string& activity);
+  // Instant marker on an arbitrary timeline row — trace-time decisions
+  // made outside the dispatch loop (the OVERLAP_PLAN schedule-planner
+  // instants from ops/schedule_plan.py) land next to the CACHE_HIT/
+  // NEGOTIATED markers.  No-op when the timeline is disabled.
+  void TimelineInstant(const std::string& row, const std::string& label);
 
   // Structured stall report: the tensors the coordinator is warning
   // about (empty on workers and when nothing is stalled).  Thread-safe

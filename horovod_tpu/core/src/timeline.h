@@ -28,8 +28,10 @@ class Timeline {
   void NegotiateEnd(const std::string& name);
   void ActivityStart(const std::string& name, const std::string& activity);
   void ActivityEnd(const std::string& name);
-  // Instant marker on the tensor's row — tags each dispatch cycle
-  // CACHE_HIT vs NEGOTIATED (docs/response_cache.md).
+  // Instant marker on the named row — tags each dispatch cycle CACHE_HIT
+  // vs NEGOTIATED (docs/response_cache.md), control-plane events
+  // (COORDINATOR_FAILOVER etc.), and the schedule planner's OVERLAP_PLAN
+  // decisions (ops/schedule_plan.py via Engine::TimelineInstant).
   void Instant(const std::string& name, const std::string& label);
   void End(const std::string& name, const std::string& result);
 

@@ -12,6 +12,14 @@ from horovod_tpu.ops.collective_ops import (  # noqa: F401
     sparse_to_dense,
 )
 from horovod_tpu.ops.compression import Compression  # noqa: F401
+from horovod_tpu.ops.schedule_plan import (  # noqa: F401
+    AdaptivePlanner,
+    BucketPlan,
+    GradientManifest,
+    Planner,
+    StaticPlanner,
+    overlap_plan,
+)
 from horovod_tpu.ops.flash_attention import (  # noqa: F401
     flash_attention,
     make_flash_attention,

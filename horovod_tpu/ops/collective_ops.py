@@ -350,7 +350,8 @@ def quantized_grouped_allreduce(tensors: Sequence, errors: Sequence | None = Non
     return reduced, resid
 
 
-def _chained_allreduce(vals: list, axes, n_buckets: int) -> list:
+def _chained_allreduce(vals: list, axes, n_buckets: int,
+                       bounds: Sequence[int] | None = None) -> list:
     """Per-tensor psums in ``n_buckets`` dependency-chained groups, reverse
     tree order (≈ backward availability: output-side layers' gradients
     exist first).
@@ -382,12 +383,21 @@ def _chained_allreduce(vals: list, axes, n_buckets: int) -> list:
     Memory trade: pulling the reductions into backward extends gradient
     live ranges, raising peak HBM by up to a few hundred MB on large
     models (measured: 468M/B=16 OOMs by 79 MB with the default chain and
-    fits with ``HOROVOD_OVERLAP_BUCKETS=0`` — docs/benchmarks.md round
-    5).  Within ~1 GB of the HBM ceiling, disable the chain first
-    (docs/troubleshooting.md OOM entry).
+    fits without it — docs/benchmarks.md round 5).  The schedule planner
+    (ops/schedule_plan.py) budgets exactly this cost against the probed
+    device headroom and degrades the depth — or bypasses the chain — when
+    it would not fit, so chain memory pressure is a planner input, not a
+    hand-tuning chore (docs/troubleshooting.md OOM entry).
+
+    ``bounds`` (from ``BucketPlan.bounds``) overrides the default
+    equal-count bucket split with explicit boundaries over the
+    reverse-order index — how a custom planner shapes buckets by bytes.
     """
     n = len(vals)
-    bounds = np.linspace(0, n, n_buckets + 1).astype(int)
+    if bounds is None:
+        bounds = np.linspace(0, n, n_buckets + 1).astype(int)
+    else:
+        bounds = np.asarray(bounds, dtype=int)
     out: dict[int, jax.Array] = {}
     gate = None
     rev = list(range(n))[::-1]
@@ -445,16 +455,23 @@ def overlap_compiler_options() -> dict:
 def grouped_allreduce(tensors: Sequence, average: bool = True,
                       compression=Compression.none,
                       threshold_bytes: int | None = None,
-                      overlap_buckets: int | None = None) -> list:
+                      overlap_buckets: int | None = None,
+                      planner=None) -> list:
     """Fused allreduce of many tensors (reference fusion-buffer semantics,
     operations.cc:1807-1842).  In-mesh on a single axis: one psum per
-    tensor in ``overlap_buckets`` dependency-chained groups (default
-    ``HOROVOD_OVERLAP_BUCKETS`` = 4; 0 restores the free-combining
-    structure whose psums XLA merges into one post-backward all-reduce —
-    see ``_chained_allreduce``), and ``threshold_bytes`` is ignored
-    (docs/tensor-fusion.md).  Hierarchical (multi-axis) meshes, the eager
-    path, and the int8 path in any context: flat ``threshold_bytes``-
-    bounded buckets (ops/fusion.py)."""
+    tensor, dependency-chained into buckets per the trace-time schedule
+    planner (ops/schedule_plan.py) — the default ``AdaptivePlanner``
+    chains at real data width with slack headroom, bypasses the chain at
+    width 1 (psum is identity there), and degrades the depth under
+    device-memory pressure; ``overlap_buckets=`` or a set
+    ``HOROVOD_OVERLAP_BUCKETS`` pins the legacy static semantics (0 =
+    free-combining, N = N chained buckets — see ``_chained_allreduce``),
+    and ``planner=`` (a schedule_plan.Planner) replaces the policy
+    outright.  The decision is observable via ``hvd.overlap_plan()``.
+    ``threshold_bytes`` is ignored on this path (docs/tensor-fusion.md).
+    Hierarchical (multi-axis) meshes, the eager path, and the int8 path
+    in any context: flat ``threshold_bytes``-bounded buckets
+    (ops/fusion.py)."""
     _record_schedule(f"grouped_allreduce[{len(tensors)}]", None,
                      tensors[0] if len(tensors) else ())
     if compression is Compression.int8:
@@ -471,15 +488,21 @@ def grouped_allreduce(tensors: Sequence, average: bool = True,
             # packing (a flat fusion buffer duplicates the backend's
             # batching and charges a pack+unpack pass over every gradient
             # byte — removing it measured +2.5 MFU points on the 162M
-            # transformer, docs/benchmarks.md round 4).  Psums are
-            # dependency-chained into buckets so they stay uncombined and
-            # overlap backward (round 5) — see _chained_allreduce.
-            from horovod_tpu.utils import env as _env
+            # transformer, docs/benchmarks.md round 4).  Whether the psums
+            # are dependency-chained into buckets (overlapping backward,
+            # round 5) or left free-combining is the schedule planner's
+            # call, made here at trace time from the gradient manifest,
+            # the data width, and the device headroom (round 9) — see
+            # ops/schedule_plan.py and _chained_allreduce.
+            from horovod_tpu.ops import schedule_plan
 
-            nb = (_env.overlap_buckets() if overlap_buckets is None
-                  else overlap_buckets)
-            if nb and nb > 1 and len(comp) > 1:
-                reduced = _chained_allreduce([c for c, _ in comp], axes, nb)
+            plan = schedule_plan.plan_overlap(
+                [c for c, _ in comp], width=denom,
+                override=overlap_buckets, planner=planner)
+            if plan.chained:
+                reduced = _chained_allreduce([c for c, _ in comp], axes,
+                                             plan.chain_depth,
+                                             bounds=plan.bounds)
             else:
                 reduced = [_mesh_allreduce(c, axes) for c, _ in comp]
         else:

@@ -228,11 +228,32 @@ def _pad_to(x, axis, multiple):
     return jnp.pad(x, widths)
 
 
+# The kernels unroll the sub-tile sweep statically (a dynamic-bound
+# fori_loop defeats Mosaic's scheduling, docs/benchmarks.md round 5), so
+# each extra sub-tile emits TWO more guarded matmul bodies (interior +
+# boundary).  Past this many sub-tiles the code-size/compile-time bill
+# grows with no measured MFU return — warn instead of silently bloating.
+MAX_SUB_TILES = 8
+
+
 def _sub_fit(block: int, sub: int) -> tuple[int, int]:
     """Clamp the compute sub-tile to the (super) block and make the block a
-    multiple of it."""
+    multiple of it.  Warns when the resulting unroll factor exceeds
+    :data:`MAX_SUB_TILES`."""
     sub = min(sub, block)
-    return max(block // sub, 1) * sub, sub
+    block = max(block // sub, 1) * sub
+    nsub = block // sub
+    if nsub > MAX_SUB_TILES:
+        import warnings
+
+        warnings.warn(
+            f"flash attention: block={block} with sub={sub} unrolls "
+            f"{nsub} sub-tiles (> {MAX_SUB_TILES}); the static unroll "
+            f"emits {2 * nsub} guarded matmul bodies — expect code-size "
+            f"and compile-time bloat with no MFU return. Raise sub= or "
+            f"lower block_q=/block_k= so block/sub <= {MAX_SUB_TILES}.",
+            stacklevel=2)
+    return block, sub
 
 
 def _flash_forward(q, k, v, causal, q_offset, k_offset, block_q, block_k,
@@ -701,6 +722,12 @@ def flash_attention(q, k, v, causal: bool = True, q_offset=0, k_offset=0,
     — while the statically-unrolled sub loop keeps scoped VMEM bounded.
     ``block_q`` stays ≤1024: the [block_q, sub] s-tile is VMEM-resident
     and 2048 exceeds the 16 MiB scope at d=128.
+
+    Keep ``block_k / sub`` (and ``block_q / sub`` in the backward) at or
+    below :data:`MAX_SUB_TILES` (8): the sub-tile sweep is statically
+    unrolled, so every sub-tile emits two guarded matmul bodies — deeper
+    unrolls bloat code size and compile time with no measured MFU return
+    (a warning fires past the bound).
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"

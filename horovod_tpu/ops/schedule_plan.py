@@ -1,0 +1,402 @@
+"""Trace-time overlap schedule planning for the compiled allreduce path.
+
+Round 5 recreated the reference's defining runtime property — comm/compute
+overlap (reference horovod/common/operations.cc fusion + hook architecture)
+— by dependency-chaining the gradient bucket psums
+(ops/collective_ops.py:_chained_allreduce).  But it shipped the chain as a
+static default (``HOROVOD_OVERLAP_BUCKETS=4``), engaged unconditionally,
+and the round-5 measurements show exactly where a static default is wrong:
+
+* at data-parallel **width 1** ``psum`` is the identity — there is nothing
+  to overlap, yet the chain still constrains the scheduler (−4.3% on the
+  single-chip ResNet headline, 2662 → 2547 img/s/chip, BENCH r04→r05);
+* the chain pulls reductions into backward, extending gradient live ranges
+  and raising peak HBM — the 468M transformer rows OOM by 79 MB under the
+  default and had to hand-set ``HOROVOD_OVERLAP_BUCKETS=0``
+  (docs/benchmarks.md round 5).
+
+This module decides the chain **per traced program** instead.  Everything a
+good decision needs is static at trace time: tensor shapes/dtypes (the
+:class:`GradientManifest`), the data-parallel width (``lax.axis_size`` is a
+concrete Python int under trace), and a device-memory headroom estimate
+(:func:`probe_headroom_mb`).  A :class:`Planner` maps those to a
+:class:`BucketPlan` — chain depth, optional bucket boundaries, or the
+free-combining bypass — and ``grouped_allreduce`` executes whatever the
+plan says.
+
+Two planners ship:
+
+* :class:`AdaptivePlanner` (the default when no override is present):
+  bypasses the chain at width 1, estimates the chain's extra live-range
+  bytes and degrades the depth (halving, down to bypass) when the estimate
+  exceeds headroom, and keeps the round-5 depth-4 chain on configs with
+  real width and slack headroom.
+* :class:`StaticPlanner`: the legacy env-knob semantics, bit-for-bit — an
+  explicit ``overlap_buckets=`` argument or a set ``HOROVOD_OVERLAP_BUCKETS``
+  / ``HVD_TPU_OVERLAP_BUCKETS`` env var routes here and wins exactly as
+  documented since round 5.
+
+The interface is the extension point for ROADMAP items 2 and 4: a
+control-plane-scale planner can shard the manifest across coordinator
+groups, and a ring-attention planner can interleave attention collectives
+into the same chain — both by returning a richer ``BucketPlan`` (explicit
+``bounds``) from a custom ``Planner`` passed to ``DistributedOptimizer``
+or ``grouped_allreduce``.
+
+Every decision is observable: :func:`overlap_plan` returns the last plan,
+rank 0 logs one line per distinct decision, and — when the native engine
+is up with ``HOROVOD_TIMELINE`` set — an ``OVERLAP_PLAN`` instant lands on
+the timeline next to the CACHE_HIT/NEGOTIATED markers
+(core/src/timeline.cc).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu.utils import env
+
+_log = logging.getLogger("horovod_tpu")
+
+# Fraction of the total gradient bytes the dependency chain keeps extra-live
+# at peak, per unit of (depth-1)/depth.  Calibrated against the round-5
+# measurement: the 468M transformer carries ~936 MB of bf16 gradients and
+# OOMed by 79 MB under the depth-4 chain — 936 MB * (3/4) * (1/8) ≈ 88 MB,
+# a deliberately conservative (over-)estimate of the measured deficit.  The
+# (depth-1)/depth factor makes the estimate monotone in depth and exactly
+# zero at depth <= 1, so degrading the chain provably shrinks the bill.
+CHAIN_LIVE_FRACTION = 1.0 / 8.0
+
+# Probed headroom is quantized DOWN to this granularity before planning.
+# The plan must be identical on every rank of an SPMD job; coarse
+# quantization absorbs small cross-host allocator jitter (for guarantees,
+# set HVD_TPU_DEVICE_HEADROOM_MB — the probe is best-effort).
+HEADROOM_QUANTUM_MB = 256.0
+
+
+@dataclasses.dataclass(frozen=True)
+class GradientManifest:
+    """Static description of the gradient set a plan covers — per-tensor
+    wire bytes and dtype names, known exactly at trace time."""
+
+    nbytes: tuple[int, ...]
+    dtypes: tuple[str, ...]
+
+    @classmethod
+    def from_tensors(cls, tensors) -> "GradientManifest":
+        nbytes, dtypes = [], []
+        for t in tensors:
+            dt = jnp.result_type(t)
+            size = 1
+            for d in jnp.shape(t):
+                size *= int(d)
+            nbytes.append(size * dt.itemsize)
+            dtypes.append(dt.name)
+        return cls(nbytes=tuple(nbytes), dtypes=tuple(dtypes))
+
+    @property
+    def count(self) -> int:
+        return len(self.nbytes)
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(self.nbytes)
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketPlan:
+    """One planner decision for one traced allreduce group.
+
+    ``chain_depth`` <= 1 (or a single tensor) means the free-combining
+    bypass: plain per-tensor psums whose batching XLA's combiner owns —
+    the round-4 structure.  ``bounds``, when set, are explicit bucket
+    boundaries (len ``chain_depth + 1``, ascending, over the reverse-order
+    tensor index) for planners that shape buckets by bytes instead of the
+    default equal-count split."""
+
+    planner: str
+    chain_depth: int
+    width: int
+    tensor_count: int
+    total_bytes: int
+    headroom_mb: float | None
+    chain_extra_bytes: int
+    reason: str
+    bounds: tuple[int, ...] | None = None
+
+    @property
+    def chained(self) -> bool:
+        return self.chain_depth > 1 and self.tensor_count > 1
+
+    def as_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["chained"] = self.chained
+        return d
+
+
+def chain_extra_bytes(total_bytes: int, depth: int) -> int:
+    """Estimated extra peak-HBM bytes of a ``depth``-bucket chain over
+    free combining (the model :data:`CHAIN_LIVE_FRACTION` documents)."""
+    if depth <= 1:
+        return 0
+    return int(total_bytes * CHAIN_LIVE_FRACTION * (depth - 1) / depth)
+
+
+class Planner:
+    """Interface: manifest + width + headroom -> :class:`BucketPlan`.
+
+    Implementations must be deterministic functions of their arguments
+    (the plan is made under trace on every rank of an SPMD job and must
+    agree everywhere).  This is the pluggable extension point ROADMAP
+    items 2 and 4 build on — pass an instance via
+    ``DistributedOptimizer(planner=...)`` or
+    ``grouped_allreduce(planner=...)``.
+    """
+
+    name = "abstract"
+
+    def plan(self, manifest: GradientManifest, width: int,
+             headroom_mb: float | None) -> BucketPlan:
+        raise NotImplementedError
+
+
+class StaticPlanner(Planner):
+    """Legacy round-5 semantics: a fixed bucket count, engaged whenever
+    depth > 1 and there is more than one tensor — regardless of width or
+    headroom.  ``HOROVOD_OVERLAP_BUCKETS`` / explicit ``overlap_buckets=``
+    route here, bit-for-bit what they did before the planner existed."""
+
+    name = "static"
+
+    def __init__(self, n_buckets: int, source: str = "overlap_buckets"):
+        self.n_buckets = int(n_buckets)
+        self.source = source
+
+    def plan(self, manifest, width, headroom_mb):
+        depth = self.n_buckets if self.n_buckets > 1 else 0
+        if manifest.count <= 1:
+            depth = 0
+        return BucketPlan(
+            planner=self.name, chain_depth=depth, width=width,
+            tensor_count=manifest.count, total_bytes=manifest.total_bytes,
+            headroom_mb=headroom_mb,
+            chain_extra_bytes=chain_extra_bytes(manifest.total_bytes, depth),
+            reason=f"explicit override via {self.source}="
+                   f"{self.n_buckets}")
+
+
+class AdaptivePlanner(Planner):
+    """The shipping default: chain only where it can pay for itself.
+
+    * width 1 -> bypass (psum is identity; chaining only constrains the
+      scheduler — the r5 −4.3% ResNet regression);
+    * headroom deficit -> halve the depth until the estimated extra
+      live-range bytes fit, down to bypass (the 468M 79 MB OOM runs with
+      no hand-set env);
+    * real width, slack headroom -> today's depth-4 chain, unchanged.
+    """
+
+    name = "adaptive"
+
+    def __init__(self, default_depth: int | None = None):
+        self.default_depth = (env.DEFAULT_OVERLAP_BUCKETS
+                              if default_depth is None else int(default_depth))
+
+    def plan(self, manifest, width, headroom_mb):
+        def mk(depth, reason):
+            return BucketPlan(
+                planner=self.name, chain_depth=depth, width=width,
+                tensor_count=manifest.count,
+                total_bytes=manifest.total_bytes, headroom_mb=headroom_mb,
+                chain_extra_bytes=chain_extra_bytes(manifest.total_bytes,
+                                                    depth),
+                reason=reason)
+
+        if width <= 1:
+            return mk(0, "width-1 bypass: psum is identity, nothing to "
+                         "overlap — free-combining structure")
+        if manifest.count <= 1:
+            return mk(0, "single gradient tensor: nothing to chain")
+        depth = self.default_depth
+        if depth <= 1:
+            return mk(0, f"default depth {depth} disables the chain")
+        if headroom_mb is None:
+            return mk(depth, f"width {width}, headroom unknown: keeping "
+                             f"default depth {depth}")
+        budget = headroom_mb * 1024.0 * 1024.0
+        if chain_extra_bytes(manifest.total_bytes, depth) <= budget:
+            return mk(depth, f"width {width}, headroom {headroom_mb:.0f} MB "
+                             f"covers the chain: keeping depth {depth}")
+        start = depth
+        while depth > 1 and chain_extra_bytes(manifest.total_bytes,
+                                              depth) > budget:
+            depth //= 2
+        if depth <= 1:
+            return mk(0, f"headroom deficit: even a 2-bucket chain "
+                         f"(+{chain_extra_bytes(manifest.total_bytes, 2)} B) "
+                         f"exceeds {headroom_mb:.0f} MB — free-combining "
+                         f"fallback")
+        return mk(depth, f"headroom deficit: degraded depth {start} -> "
+                         f"{depth} to fit {headroom_mb:.0f} MB")
+
+
+# ---------------------------------------------------------------------------
+# Headroom probe
+# ---------------------------------------------------------------------------
+
+_probe_lock = threading.Lock()
+_probe_cache: list = []  # [float | None] once probed — one answer per process
+
+
+def probe_headroom_mb() -> float | None:
+    """Device-memory headroom estimate in MB, or None when unknowable.
+
+    ``HVD_TPU_DEVICE_HEADROOM_MB`` wins when set (the deterministic path —
+    recommended for multi-host jobs and required for AOT/CPU/sim, where no
+    addressable device reports memory stats).  Otherwise probe
+    ``device.memory_stats()`` on the addressable devices (JAX TPU exposes
+    ``bytes_limit`` / ``bytes_in_use``), take the minimum free estimate,
+    and quantize DOWN to :data:`HEADROOM_QUANTUM_MB` so allocator jitter
+    cannot fork the plan across ranks.  The probe result is cached for the
+    process lifetime: repeated traces of the same program must see the
+    same answer (plan stability), not a headroom that drifts as buffers
+    come and go.
+    """
+    override = env.device_headroom_mb()
+    if override is not None:
+        return override
+    with _probe_lock:
+        if _probe_cache:
+            return _probe_cache[0]
+        headroom = None
+        try:
+            frees = []
+            for dev in jax.local_devices():
+                stats = getattr(dev, "memory_stats", lambda: None)()
+                if not stats:
+                    continue
+                limit = stats.get("bytes_limit")
+                in_use = stats.get("bytes_in_use")
+                if limit is None or in_use is None:
+                    continue
+                frees.append(max(int(limit) - int(in_use), 0))
+            if frees:
+                mb = min(frees) / (1024.0 * 1024.0)
+                headroom = (mb // HEADROOM_QUANTUM_MB) * HEADROOM_QUANTUM_MB
+        except Exception:  # backend without devices yet (AOT) — unknown
+            headroom = None
+        _probe_cache.append(headroom)
+        return headroom
+
+
+# ---------------------------------------------------------------------------
+# Entry point + observability
+# ---------------------------------------------------------------------------
+
+_plan_lock = threading.Lock()
+_last_plan: BucketPlan | None = None
+_logged_keys: set = set()
+
+
+def plan_overlap(tensors, width: int, override: int | None = None,
+                 planner: Planner | None = None) -> BucketPlan:
+    """Make (and record) the bucket plan for one traced allreduce group.
+
+    Resolution order — most explicit wins:
+
+    1. a ``planner`` instance passed in code;
+    2. an explicit ``overlap_buckets=`` argument (``override``) ->
+       :class:`StaticPlanner`, legacy semantics;
+    3. a set ``HOROVOD_OVERLAP_BUCKETS`` / ``HVD_TPU_OVERLAP_BUCKETS``
+       env var -> :class:`StaticPlanner` (malformed values degrade to the
+       documented default-with-warning, unchanged from round 5);
+    4. :class:`AdaptivePlanner`.
+    """
+    if planner is None:
+        if override is not None:
+            planner = StaticPlanner(override, source="overlap_buckets")
+        else:
+            env_depth = env.overlap_buckets_override()
+            if env_depth is not None:
+                planner = StaticPlanner(env_depth,
+                                        source="HOROVOD_OVERLAP_BUCKETS")
+            else:
+                planner = AdaptivePlanner()
+    manifest = GradientManifest.from_tensors(tensors)
+    plan = planner.plan(manifest, width, probe_headroom_mb())
+    _record(plan)
+    return plan
+
+
+def overlap_plan() -> dict | None:
+    """The most recent :class:`BucketPlan` as a dict (``hvd.overlap_plan()``),
+    or None before any compiled allreduce group has been planned.  Keys:
+    planner, chain_depth, chained, width, tensor_count, total_bytes,
+    headroom_mb, chain_extra_bytes, bounds, reason."""
+    with _plan_lock:
+        return _last_plan.as_dict() if _last_plan is not None else None
+
+
+def _record(plan: BucketPlan) -> None:
+    global _last_plan
+    key = (plan.planner, plan.chain_depth, plan.width, plan.tensor_count,
+           plan.total_bytes, plan.headroom_mb, plan.bounds)
+    with _plan_lock:
+        _last_plan = plan
+        fresh = key not in _logged_keys
+        if fresh:
+            _logged_keys.add(key)
+    if not fresh:
+        return  # retraces of the same program repeat the same decision
+    if _is_rank0():
+        hr = ("unknown" if plan.headroom_mb is None
+              else f"{plan.headroom_mb:.0f}MB")
+        _log.info(
+            "overlap plan: planner=%s width=%d headroom=%s depth=%d "
+            "tensors=%d bytes=%d — %s", plan.planner, plan.width, hr,
+            plan.chain_depth, plan.tensor_count, plan.total_bytes,
+            plan.reason)
+    _emit_timeline(plan)
+
+
+def _is_rank0() -> bool:
+    try:
+        from horovod_tpu import basics
+
+        return basics.rank() == 0
+    except Exception:  # before init: single-process semantics
+        return True
+
+
+def _emit_timeline(plan: BucketPlan) -> None:
+    """OVERLAP_PLAN instant on the native timeline — only when the engine
+    is already up (peek, never boot) and rank 0 has a timeline file."""
+    try:
+        from horovod_tpu.core import engine
+
+        eng = engine.peek_engine()
+        if eng is None:
+            return
+        hr = ("unknown" if plan.headroom_mb is None
+              else f"{plan.headroom_mb:.0f}MB")
+        eng.timeline_instant(
+            "overlap_plan",
+            f"OVERLAP_PLAN planner={plan.planner} width={plan.width} "
+            f"headroom={hr} depth={plan.chain_depth}")
+    except Exception:  # observability must never break tracing
+        pass
+
+
+def _reset_for_tests() -> None:
+    """Drop the cached probe/log state (test isolation only)."""
+    global _last_plan
+    with _probe_lock:
+        _probe_cache.clear()
+    with _plan_lock:
+        _last_plan = None
+        _logged_keys.clear()

@@ -67,6 +67,7 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
                          threshold_bytes: int | None = None,
                          sharded_state: bool = False,
                          overlap_buckets: int | None = None,
+                         planner=None,
                          ) -> optax.GradientTransformation:
     """Wrap ``optimizer`` so updates see globally-averaged gradients.
 
@@ -89,23 +90,35 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
     device, and updates all-gather back (parallel/zero.py; in-mesh only,
     elementwise transforms).
 
-    ``overlap_buckets`` (default ``HOROVOD_OVERLAP_BUCKETS`` = 4; 0
-    disables) chains the single-axis bucket psums so the backend
-    schedules early buckets' all-reduces during backward — pass
+    Comm/compute overlap on the single-axis path is decided per traced
+    program by the schedule planner (ops/schedule_plan.py): at trace time
+    the gradient manifest (per-tensor bytes/dtypes of the flattened
+    ``grads``), the probed data-parallel width, and the device-memory
+    headroom pick a chain depth — chaining the bucket psums so the
+    backend schedules early buckets' all-reduces during backward, and
+    bypassing the chain where it cannot help (width 1) or cannot fit
+    (headroom deficit).  ``overlap_buckets`` (or a set
+    ``HOROVOD_OVERLAP_BUCKETS``; 0 disables, N pins N buckets) overrides
+    the planner with the legacy static semantics; ``planner=`` (a
+    ``schedule_plan.Planner``) replaces the policy — the extension point
+    for custom schedules.  Pass
     ``compiler_options=hvd.overlap_compiler_options()`` to ``jax.jit`` to
-    make them asynchronous (collective_ops._chained_allreduce).
+    make the chained all-reduces asynchronous
+    (collective_ops._chained_allreduce); inspect the decision with
+    ``hvd.overlap_plan()``.
     """
     if sharded_state:
         # overlap_buckets=0 means "disabled" and is compatible (a user
         # mirroring HOROVOD_OVERLAP_BUCKETS=0 into code must not error).
         if (compression is not Compression.none
                 or threshold_bytes is not None
+                or planner is not None
                 or overlap_buckets not in (None, 0)):
             raise ValueError(
                 "sharded_state=True uses a reduce-scatter of the flat "
                 "gradient vector; compression/threshold_bytes/"
-                "overlap_buckets do not apply to that path — drop them or "
-                "use the replicated optimizer.")
+                "overlap_buckets/planner do not apply to that path — drop "
+                "them or use the replicated optimizer.")
         from horovod_tpu.parallel.zero import zero_optimizer
 
         return zero_optimizer(optimizer, average=average)
@@ -142,7 +155,7 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
         reduced = collective_ops.grouped_allreduce(
             leaves, average=average, compression=compression,
             threshold_bytes=threshold_bytes,
-            overlap_buckets=overlap_buckets)
+            overlap_buckets=overlap_buckets, planner=planner)
         grads = jax.tree.unflatten(treedef, reduced)
         updates, inner = optimizer.update(grads, state.inner, params, **extra)
         return updates, DistributedState(inner=inner)

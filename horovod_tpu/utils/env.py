@@ -77,6 +77,19 @@ aliases; the TPU-specific defaults differ where the hardware does:
 * ``HVD_TPU_RECONFIG_TIMEOUT_MS`` — bound (default 30000) on in-place
   reconfiguration (resize acknowledgement + re-rendezvous); expiry falls
   back to abort-and-restart, keeping the nothing-blocks-forever guarantee.
+* ``HOROVOD_OVERLAP_BUCKETS`` — chained-bucket OVERRIDE for the compiled
+  single-axis allreduce path.  Unset (the default): the AdaptivePlanner
+  (ops/schedule_plan.py) picks the chain depth at trace time from the
+  data-parallel width, the gradient manifest, and the device-memory
+  headroom — bypassing the chain at width 1 and degrading depth under
+  headroom pressure.  Any set value pins the legacy StaticPlanner
+  semantics exactly (0 = free-combining, N = N chained buckets),
+  bit-for-bit what rounds 5–8 shipped (docs/tensor-fusion.md).
+* ``HVD_TPU_DEVICE_HEADROOM_MB`` — device-memory headroom estimate (MB)
+  the schedule planner budgets against, overriding the
+  ``device.memory_stats()`` probe.  Needed on AOT/CPU/sim paths (no
+  stats) and recommended on multi-host jobs (a live probe could diverge
+  across ranks; the override keeps the plan identical everywhere).
 * ``HVD_TPU_FAULT_*`` — deterministic fault injection (faults.py),
   including the wire-level chaos injectors
   ``HVD_TPU_FAULT_WIRE_{DROP,CORRUPT,PARTITION,HALFCLOSE}`` =
@@ -295,3 +308,46 @@ def overlap_buckets() -> int:
             RuntimeWarning, stacklevel=2)
         return DEFAULT_OVERLAP_BUCKETS
     return value
+
+
+def overlap_buckets_override() -> int | None:
+    """The explicitly-requested chained-bucket count, or None when the env
+    carries no override.
+
+    Since the schedule planner (ops/schedule_plan.py) the bucket env vars
+    are an OVERRIDE, not the default: unset means "let the AdaptivePlanner
+    choose from width/manifest/headroom", while any set value — including
+    0 — pins the legacy StaticPlanner semantics bit-for-bit.  A set-but-
+    malformed value still degrades to :data:`DEFAULT_OVERLAP_BUCKETS` with
+    the :func:`overlap_buckets` warning (the typo'd launch script gets
+    round-5 behavior, not a crash and not a silently different plan)."""
+    raw = _get("OVERLAP_BUCKETS")
+    if not raw:
+        return None
+    return overlap_buckets()
+
+
+def device_headroom_mb() -> float | None:
+    """``HVD_TPU_DEVICE_HEADROOM_MB`` — device-memory headroom estimate
+    (MB) the schedule planner budgets its chain live-range cost against,
+    overriding the ``device.memory_stats()`` probe.  Set it on AOT/CPU/sim
+    paths where no device exposes memory stats, and on multi-host jobs
+    where a live probe could diverge across ranks (the plan must be
+    identical everywhere — SPMD).  Unset/malformed: None (probe, or treat
+    headroom as unknown); negative values clamp to 0 (no headroom)."""
+    raw = _get("DEVICE_HEADROOM_MB")
+    if raw in (None, ""):
+        return None
+    try:
+        value = float(raw)
+    except ValueError:
+        import warnings
+
+        name = ("HOROVOD_DEVICE_HEADROOM_MB"
+                if "HOROVOD_DEVICE_HEADROOM_MB" in os.environ
+                else "HVD_TPU_DEVICE_HEADROOM_MB")
+        warnings.warn(
+            f"{name}={raw!r} is not a number; ignoring the override "
+            f"(headroom stays unknown)", RuntimeWarning, stacklevel=2)
+        return None
+    return max(value, 0.0)

@@ -100,6 +100,40 @@ def test_subtiled_kernels_match_dense(hvd, s):
         np.testing.assert_allclose(a, b, atol=5e-4, rtol=5e-4)
 
 
+def test_deep_sub_tile_unroll_warns(hvd):
+    """The sub-tile sweep is statically unrolled — each sub-tile emits two
+    guarded matmul bodies — so geometry past MAX_SUB_TILES (8) must warn,
+    naming the block/sub/nsub numbers, instead of silently bloating the
+    compile.  Numerics stay correct either way."""
+    import warnings
+
+    from horovod_tpu.ops.flash_attention import MAX_SUB_TILES, _sub_fit
+
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        assert _sub_fit(1024, 64) == (1024, 64)  # nsub = 16 > 8
+    assert len(caught) == 1
+    msg = str(caught[0].message)
+    assert "16 sub-tiles" in msg and "32 guarded" in msg
+    assert f"<= {MAX_SUB_TILES}" in msg
+
+    # At or under the bound: silent (the shipped defaults stay nsub <= 2).
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        _sub_fit(1024, 128)   # nsub = 8: the documented edge, no warning
+        _sub_fit(2048, 1024)  # the block_k=2048/sub=1024 shipped default
+    assert caught == []
+
+    # The public entry point routes its geometry through the same check.
+    q, k, v = _qkv(s=64)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        out = flash_attention(q, k, v, block_q=64, block_k=64, sub=4)
+    assert any("sub-tiles" in str(w.message) for w in caught)
+    ref = dense_causal_attention(q, k, v)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
 def test_bf16_gradients(hvd):
     """bf16 end to end through the backward kernels: the input-dtype
     matmul path (round 5 — bf16 operands, f32 accumulation, scale-fold

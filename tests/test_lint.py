@@ -306,6 +306,51 @@ def test_hvd106_exempt_when_refreshed_in_on_reconfigure_callback():
 
 
 # ---------------------------------------------------------------------------
+# HVD107 — hand-tuned overlap knob (the schedule planner owns the chain)
+# ---------------------------------------------------------------------------
+
+def test_hvd107_env_assignment_and_setdefault():
+    assert codes("""
+        import os
+
+        os.environ["HOROVOD_OVERLAP_BUCKETS"] = "4"
+        os.environ.setdefault("HVD_TPU_OVERLAP_BUCKETS", "0")
+    """) == ["HVD107", "HVD107"]
+
+
+def test_hvd107_monkeypatch_setenv():
+    assert codes("""
+        def test_thing(monkeypatch):
+            monkeypatch.setenv("HOROVOD_OVERLAP_BUCKETS", "0")
+    """) == ["HVD107"]
+
+
+def test_hvd107_clean_other_knobs_and_reads():
+    # Reading the knob, deleting it, and setting unrelated vars is fine —
+    # only SETTING the overlap knob rots into hand-tuned cargo culting.
+    assert codes("""
+        import os
+
+        n = os.environ.get("HOROVOD_OVERLAP_BUCKETS")
+        os.environ.pop("HOROVOD_OVERLAP_BUCKETS", None)
+        os.environ["HOROVOD_CYCLE_TIME"] = "3.5"
+
+        def test_thing(monkeypatch):
+            monkeypatch.delenv("HOROVOD_OVERLAP_BUCKETS", raising=False)
+            monkeypatch.setenv("HVD_TPU_DEVICE_HEADROOM_MB", "3")
+    """) == []
+
+
+def test_hvd107_suppressible_for_legacy_fixtures():
+    # In-repo legacy-branch fixtures (tests pinning StaticPlanner
+    # semantics) stay, exempted line by line — visible, not normalized.
+    assert codes("""
+        def test_legacy(monkeypatch):
+            monkeypatch.setenv("HOROVOD_OVERLAP_BUCKETS", "0")  # hvd-lint: disable=HVD107
+    """) == []
+
+
+# ---------------------------------------------------------------------------
 # Suppression + driver behaviour
 # ---------------------------------------------------------------------------
 

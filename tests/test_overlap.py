@@ -16,6 +16,15 @@ These tests pin both sides on the CPU sim: the shipped default keeps the
 bucket all-reduces split and interleaved; disabling the chain
 (HOROVOD_OVERLAP_BUCKETS=0) reproduces the round-4 single-merged-AR
 structure, so a future XLA that changes either behavior flips loudly.
+
+Round 9: the chain decision moved into the trace-time schedule planner
+(ops/schedule_plan.py), so BOTH planner branches are pinned here — the
+adaptive default still chains at the sim mesh's real width (8), and the
+same step lowered over a one-device mesh must carry ZERO chain gates
+(width 1: psum is identity, the chain only constrained the scheduler —
+the r5 −4.3% ResNet headline regression).  The ``is_finite`` count in the
+lowered stablehlo is the structural probe: the chain's arithmetic gate is
+this model's only source of that op.
 """
 
 import pytest
@@ -51,9 +60,10 @@ def test_buckets_issued_before_combining(audit):
 
 
 def test_chained_buckets_survive_and_interleave(audit):
-    # The shipped default (HOROVOD_OVERLAP_BUCKETS=4): the dependency
-    # chain keeps the bucket all-reduces uncombined...  (The DEFAULT
-    # constant, not the live env: the fixture lowered under the default.)
+    # The shipped default (AdaptivePlanner at the sim's width 8 keeps the
+    # depth-4 chain): the dependency chain keeps the bucket all-reduces
+    # uncombined...  (The DEFAULT constant, not the live env: the fixture
+    # lowered under the default.)
     from horovod_tpu.utils import env
 
     assert audit["all_reduce_ops"] >= env.DEFAULT_OVERLAP_BUCKETS, audit
@@ -72,6 +82,40 @@ def test_chained_buckets_assertion_uses_default(audit):
     assert audit["all_reduce_ops"] >= env.DEFAULT_OVERLAP_BUCKETS
 
 
+def test_adaptive_planner_chains_at_real_width(audit):
+    # Branch 1 of the planner: at the sim mesh's real width (8) the
+    # adaptive default keeps the depth-4 chain — plan recorded, gates in
+    # the lowered stablehlo (one gate between consecutive buckets).
+    from horovod_tpu.utils import env
+
+    plan = audit["plan"]
+    assert plan is not None and plan["planner"] == "adaptive", plan
+    assert plan["chained"] and plan["chain_depth"] == \
+        env.DEFAULT_OVERLAP_BUCKETS, plan
+    assert plan["width"] == 8, plan
+    assert audit["gate_is_finite_ops"] == env.DEFAULT_OVERLAP_BUCKETS - 1, \
+        audit
+
+
+def test_adaptive_planner_width1_bypasses_chain(monkeypatch):
+    # Branch 2: the same step over a ONE-device mesh must lower with NO
+    # dependency chain — zero is_finite gates, the round-4 free-combining
+    # structure — and the recorded plan must say why (width-1 bypass).
+    # This is the r5 ResNet headline regression, pinned dead.
+    monkeypatch.delenv("HOROVOD_OVERLAP_BUCKETS", raising=False)
+    monkeypatch.delenv("HVD_TPU_OVERLAP_BUCKETS", raising=False)
+    import horovod_tpu as hvd
+
+    hvd.init()
+    from examples.overlap_audit import audit_cpu_sim_width1
+
+    audit = audit_cpu_sim_width1()
+    assert audit["gate_is_finite_ops"] == 0, audit
+    plan = audit["plan"]
+    assert plan["planner"] == "adaptive" and plan["chain_depth"] == 0, plan
+    assert not plan["chained"] and plan["width"] == 1, plan
+
+
 def test_disabling_chain_restores_single_merged_all_reduce(monkeypatch):
     monkeypatch.delenv("HVD_TPU_OVERLAP_BUCKETS", raising=False)
     # HOROVOD_OVERLAP_BUCKETS=0 restores the round-4 free-combining
@@ -81,7 +125,8 @@ def test_disabling_chain_restores_single_merged_all_reduce(monkeypatch):
     import horovod_tpu as hvd
 
     hvd.init()
-    monkeypatch.setenv("HOROVOD_OVERLAP_BUCKETS", "0")
+    # Deliberate legacy-branch fixture, not a recommendation (HVD107).
+    monkeypatch.setenv("HOROVOD_OVERLAP_BUCKETS", "0")  # hvd-lint: disable=HVD107
     from examples.overlap_audit import audit_cpu_sim
 
     audit = audit_cpu_sim()
@@ -108,14 +153,14 @@ def test_overlap_buckets_malformed_env_falls_back_with_warning(monkeypatch):
     from horovod_tpu.utils import env
 
     monkeypatch.delenv("HOROVOD_OVERLAP_BUCKETS", raising=False)
-    monkeypatch.setenv("HVD_TPU_OVERLAP_BUCKETS", "fourish")
+    monkeypatch.setenv("HVD_TPU_OVERLAP_BUCKETS", "fourish")  # hvd-lint: disable=HVD107
     with warnings.catch_warnings(record=True) as caught:
         warnings.simplefilter("always")
         assert env.overlap_buckets() == env.DEFAULT_OVERLAP_BUCKETS
     assert any("HVD_TPU_OVERLAP_BUCKETS" in str(w.message) for w in caught)
 
     # The HOROVOD_* spelling wins the lookup and is named in the warning.
-    monkeypatch.setenv("HOROVOD_OVERLAP_BUCKETS", "-3")
+    monkeypatch.setenv("HOROVOD_OVERLAP_BUCKETS", "-3")  # hvd-lint: disable=HVD107
     with warnings.catch_warnings(record=True) as caught:
         warnings.simplefilter("always")
         assert env.overlap_buckets() == env.DEFAULT_OVERLAP_BUCKETS
@@ -124,12 +169,17 @@ def test_overlap_buckets_malformed_env_falls_back_with_warning(monkeypatch):
 
 def test_overlap_buckets_well_formed_env_still_parses(monkeypatch):
     monkeypatch.delenv("HOROVOD_OVERLAP_BUCKETS", raising=False)
-    monkeypatch.setenv("HVD_TPU_OVERLAP_BUCKETS", "7")
+    monkeypatch.setenv("HVD_TPU_OVERLAP_BUCKETS", "7")  # hvd-lint: disable=HVD107
     from horovod_tpu.utils import env
 
     assert env.overlap_buckets() == 7
-    monkeypatch.setenv("HVD_TPU_OVERLAP_BUCKETS", "0")
+    assert env.overlap_buckets_override() == 7
+    monkeypatch.setenv("HVD_TPU_OVERLAP_BUCKETS", "0")  # hvd-lint: disable=HVD107
     assert env.overlap_buckets() == 0
+    assert env.overlap_buckets_override() == 0
+    monkeypatch.delenv("HVD_TPU_OVERLAP_BUCKETS", raising=False)
+    # Unset: no override — the adaptive planner owns the decision.
+    assert env.overlap_buckets_override() is None
 
 
 def test_overlap_compiler_options_shape():

@@ -1,0 +1,242 @@
+"""Unit + lowering tests for the trace-time overlap schedule planner
+(ops/schedule_plan.py) — ISSUE 9's tentpole.
+
+The planner's contract, pinned here:
+
+* width-1 bypass (the r5 −4.3% ResNet headline regression: chaining where
+  psum is identity only constrains the scheduler);
+* headroom-deficit degradation — the 468M config's 79 MB OOM must turn
+  into a shallower chain (or free-combining fallback) with NO hand-set
+  ``HOROVOD_OVERLAP_BUCKETS``;
+* explicit overrides (argument, env, custom planner instance) win
+  bit-for-bit over the adaptive plan;
+* plan stability: the same manifest/width/headroom always produces the
+  same plan, across repeated traces.
+"""
+
+import numpy as np
+import pytest
+
+from horovod_tpu.ops import schedule_plan as sp
+from horovod_tpu.utils import env
+
+
+@pytest.fixture(autouse=True)
+def _fresh_planner_state(monkeypatch):
+    # Planner decisions must come from THIS test's env, not the shell's;
+    # the probe cache and dedup log reset so tests stay order-independent.
+    monkeypatch.delenv("HOROVOD_OVERLAP_BUCKETS", raising=False)
+    monkeypatch.delenv("HVD_TPU_OVERLAP_BUCKETS", raising=False)
+    monkeypatch.delenv("HOROVOD_DEVICE_HEADROOM_MB", raising=False)
+    monkeypatch.delenv("HVD_TPU_DEVICE_HEADROOM_MB", raising=False)
+    sp._reset_for_tests()
+    yield
+    sp._reset_for_tests()
+
+
+def manifest(count=18, bytes_per=2 * 1024 * 1024):
+    return sp.GradientManifest(nbytes=(bytes_per,) * count,
+                               dtypes=("float32",) * count)
+
+
+# ---------------------------------------------------------------------------
+# AdaptivePlanner policy
+# ---------------------------------------------------------------------------
+
+def test_width1_bypasses_chain():
+    plan = sp.AdaptivePlanner().plan(manifest(), width=1, headroom_mb=None)
+    assert plan.chain_depth == 0 and not plan.chained
+    assert "width-1" in plan.reason
+    # Bypass even with infinite headroom — width, not memory, is the
+    # reason there is nothing to overlap.
+    plan = sp.AdaptivePlanner().plan(manifest(), width=1, headroom_mb=1e9)
+    assert not plan.chained
+
+
+def test_real_width_slack_headroom_keeps_default_depth():
+    plan = sp.AdaptivePlanner().plan(manifest(), width=8,
+                                     headroom_mb=8000.0)
+    assert plan.chain_depth == env.DEFAULT_OVERLAP_BUCKETS and plan.chained
+
+
+def test_unknown_headroom_keeps_default_depth():
+    plan = sp.AdaptivePlanner().plan(manifest(), width=8, headroom_mb=None)
+    assert plan.chain_depth == env.DEFAULT_OVERLAP_BUCKETS and plan.chained
+
+
+def test_headroom_deficit_degrades_depth_then_bypasses():
+    # The 468M shape: ~936 MB of bf16 gradients.  The depth-4 chain's
+    # estimated extra live-range (~88 MB — calibrated to the measured
+    # 79 MB OOM, see CHAIN_LIVE_FRACTION) exceeds an 80 MB headroom, so
+    # the planner halves the depth; a tiny headroom kills the chain.
+    m = sp.GradientManifest(
+        nbytes=(936 * 1024 * 1024 // 20,) * 20, dtypes=("bfloat16",) * 20)
+    assert sp.chain_extra_bytes(m.total_bytes, 4) > 80 * 1024 * 1024
+    degraded = sp.AdaptivePlanner().plan(m, width=16, headroom_mb=80.0)
+    assert 1 < degraded.chain_depth < env.DEFAULT_OVERLAP_BUCKETS
+    assert sp.chain_extra_bytes(m.total_bytes, degraded.chain_depth) \
+        <= 80 * 1024 * 1024
+    assert "degraded" in degraded.reason
+    dead = sp.AdaptivePlanner().plan(m, width=16, headroom_mb=10.0)
+    assert dead.chain_depth == 0 and not dead.chained
+    assert "free-combining" in dead.reason
+
+
+def test_chain_extra_bytes_monotone_and_zero_without_chain():
+    total = 936 * 1024 * 1024
+    estimates = [sp.chain_extra_bytes(total, d) for d in (8, 4, 2, 1, 0)]
+    assert estimates == sorted(estimates, reverse=True)
+    assert estimates[-2:] == [0, 0]  # depth <= 1: no chain, no bill
+
+
+def test_single_tensor_never_chains():
+    plan = sp.AdaptivePlanner().plan(manifest(count=1), width=8,
+                                     headroom_mb=None)
+    assert not plan.chained and plan.chain_depth == 0
+
+
+# ---------------------------------------------------------------------------
+# Overrides beat the adaptive plan
+# ---------------------------------------------------------------------------
+
+def test_argument_override_beats_adaptive():
+    # overlap_buckets=6 at width 1: legacy semantics chain anyway —
+    # bit-for-bit what the knob did before the planner existed.
+    t = [np.zeros((8, 8), np.float32)] * 4
+    plan = sp.plan_overlap(t, width=1, override=6)
+    assert plan.planner == "static" and plan.chain_depth == 6
+    assert plan.chained  # width is irrelevant to the static branch
+    off = sp.plan_overlap(t, width=8, override=0)
+    assert off.planner == "static" and not off.chained
+
+
+def test_env_override_beats_adaptive(monkeypatch):
+    # Legacy-pin fixture on purpose (the planner normally decides).
+    monkeypatch.setenv("HOROVOD_OVERLAP_BUCKETS", "5")  # hvd-lint: disable=HVD107
+    t = [np.zeros((8, 8), np.float32)] * 4
+    plan = sp.plan_overlap(t, width=1, override=None)
+    assert plan.planner == "static" and plan.chain_depth == 5
+
+
+def test_argument_beats_env(monkeypatch):
+    monkeypatch.setenv("HOROVOD_OVERLAP_BUCKETS", "5")  # hvd-lint: disable=HVD107
+    t = [np.zeros((8, 8), np.float32)] * 4
+    plan = sp.plan_overlap(t, width=8, override=2)
+    assert plan.chain_depth == 2
+
+
+def test_custom_planner_instance_wins(monkeypatch):
+    monkeypatch.setenv("HOROVOD_OVERLAP_BUCKETS", "5")  # hvd-lint: disable=HVD107
+
+    class Fixed3(sp.Planner):
+        name = "fixed3"
+
+        def plan(self, m, width, headroom_mb):
+            return sp.BucketPlan(
+                planner=self.name, chain_depth=3, width=width,
+                tensor_count=m.count, total_bytes=m.total_bytes,
+                headroom_mb=headroom_mb, chain_extra_bytes=0,
+                reason="test planner")
+
+    t = [np.zeros((8, 8), np.float32)] * 4
+    plan = sp.plan_overlap(t, width=8, planner=Fixed3())
+    assert plan.planner == "fixed3" and plan.chain_depth == 3
+
+
+def test_malformed_env_override_degrades_to_static_default(monkeypatch):
+    # A typo'd knob stays on the round-5 path (static depth 4 + warning),
+    # NOT silently adaptive — set-but-broken must not change semantics.
+    import warnings
+
+    monkeypatch.setenv("HOROVOD_OVERLAP_BUCKETS", "four")  # hvd-lint: disable=HVD107
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        t = [np.zeros((8, 8), np.float32)] * 4
+        plan = sp.plan_overlap(t, width=1, override=None)
+    assert plan.planner == "static"
+    assert plan.chain_depth == env.DEFAULT_OVERLAP_BUCKETS
+    assert any("HOROVOD_OVERLAP_BUCKETS" in str(w.message) for w in caught)
+
+
+# ---------------------------------------------------------------------------
+# Stability + observability
+# ---------------------------------------------------------------------------
+
+def test_plan_stable_across_repeated_traces():
+    t = [np.zeros((64, 64), np.float32)] * 8
+    plans = [sp.plan_overlap(t, width=8) for _ in range(3)]
+    assert plans[0] == plans[1] == plans[2]
+    import horovod_tpu as hvd
+
+    last = hvd.overlap_plan()
+    assert last == plans[-1].as_dict()
+    assert last["chained"] and last["planner"] == "adaptive"
+
+
+def test_overlap_plan_none_before_any_decision():
+    import horovod_tpu as hvd
+
+    assert hvd.overlap_plan() is None
+
+
+def test_headroom_env_override_wins_and_is_deterministic(monkeypatch):
+    monkeypatch.setenv("HVD_TPU_DEVICE_HEADROOM_MB", "50")
+    assert sp.probe_headroom_mb() == 50.0
+    assert env.device_headroom_mb() == 50.0
+    monkeypatch.setenv("HVD_TPU_DEVICE_HEADROOM_MB", "-5")
+    assert sp.probe_headroom_mb() == 0.0  # negative clamps to "none left"
+
+
+def test_headroom_env_malformed_warns_and_probes(monkeypatch):
+    import warnings
+
+    monkeypatch.setenv("HVD_TPU_DEVICE_HEADROOM_MB", "lots")
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        assert env.device_headroom_mb() is None
+    assert any("HVD_TPU_DEVICE_HEADROOM_MB" in str(w.message)
+               for w in caught)
+
+
+def test_probe_result_is_cached_per_process(monkeypatch):
+    # Plan stability across retraces requires one probe answer per
+    # process — not a live value that drifts as buffers come and go.
+    first = sp.probe_headroom_mb()
+    assert sp.probe_headroom_mb() == first
+    assert sp._probe_cache == [first]
+
+
+# ---------------------------------------------------------------------------
+# Lowering integration: headroom deficit reshapes the compiled program
+# ---------------------------------------------------------------------------
+
+def test_simulated_headroom_deficit_degrades_lowered_chain(monkeypatch):
+    # Acceptance: a simulated deficit (HVD_TPU_DEVICE_HEADROOM_MB) makes
+    # the planner degrade chain depth in the ACTUAL lowered program, with
+    # no hand-set HOROVOD_OVERLAP_BUCKETS anywhere.  The audit model
+    # carries ~33.6 MB of gradients -> depth-4 chain bill ≈ 3.01 MB,
+    # depth-2 ≈ 2.0 MB: a 3 MB headroom forces exactly one halving.
+    import horovod_tpu as hvd
+
+    hvd.init()
+    monkeypatch.setenv("HVD_TPU_DEVICE_HEADROOM_MB", "3")
+    from examples.overlap_audit import audit_cpu_sim
+
+    audit = audit_cpu_sim()
+    plan = audit["plan"]
+    assert plan["planner"] == "adaptive", plan
+    assert plan["chain_depth"] == 2, plan
+    assert plan["headroom_mb"] == 3.0, plan
+    # depth 2 -> exactly one inter-bucket gate survives in the stablehlo.
+    assert audit["gate_is_finite_ops"] == 1, audit
+
+
+def test_distributed_optimizer_planner_kwarg_rejected_with_zero1():
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.ops import StaticPlanner
+
+    with pytest.raises(ValueError, match="planner"):
+        hvd.DistributedOptimizer(optax.sgd(0.01), sharded_state=True,
+                                 planner=StaticPlanner(4))
