@@ -40,8 +40,23 @@ Two phases, one JSON metric line each:
         "vs_baseline": <gates the r5 static default emitted>,
         "plan": {...}}
 
+2c. **Checkpoint snapshot-stall microbench** — times what the TRAIN LOOP
+   pays per checkpoint under the async persist split
+   (``HVD_TPU_CKPT_ASYNC=1``, checkpoint.CheckpointManager: snapshot at
+   the step barrier, commit on the background persist thread) against
+   the synchronous save of the SAME state on the same run::
+
+       {"metric": "checkpoint_stall_ms", "value": N, "unit": "ms",
+        "vs_baseline": <sync_ms / stall_ms>, "checkpoint_sync_ms": M,
+        "state_bytes": B}
+
+   ``BENCH_CKPT_BYTES`` sizes the state (default 64 MiB; use
+   ``1872000000`` for the 468M-param f32 config the docs row records);
+   the acceptance bar is stall < one step time at that config
+   (docs/benchmarks.md).
+
 ``BENCH_SKIP_EAGER=1`` / ``BENCH_SKIP_RESNET=1`` / ``BENCH_SKIP_PLAN=1``
-skip individual phases.
+/ ``BENCH_SKIP_CKPT=1`` skip individual phases.
 
 3. **Fault-detection MTTR** (``bench.py --fault``) — two-process engine
    job; rank 1 is SIGKILLed at steady state and the survivor's
@@ -369,6 +384,65 @@ def elastic_bench() -> None:
     }))
 
 
+def checkpoint_bench() -> None:
+    """Snapshot-stall of the async persist split vs the synchronous save.
+
+    One process, one state dict of ``BENCH_CKPT_BYTES`` of float32: the
+    sync manager's ``save()`` (payload write + ``_COMMIT`` inline) is the
+    baseline; the async manager's ``save()`` returns after the snapshot
+    (orbax async kick + persist-thread enqueue), so its call time IS the
+    per-checkpoint train-loop stall the tentpole exists to shrink.
+    Median of ``BENCH_CKPT_STEPS`` saves each, same state both times."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from horovod_tpu import checkpoint as hvd_checkpoint
+
+    nbytes = int(os.environ.get("BENCH_CKPT_BYTES", str(64 << 20)))
+    steps = int(os.environ.get("BENCH_CKPT_STEPS", "5"))
+    state = {"params": np.random.default_rng(0)
+             .standard_normal(max(1, nbytes // 4)).astype(np.float32)}
+
+    def run(async_mode: bool) -> float:
+        root = tempfile.mkdtemp(prefix="bench-ckpt-")
+        saved = os.environ.get("HVD_TPU_CKPT_ASYNC")
+        os.environ["HVD_TPU_CKPT_ASYNC"] = "1" if async_mode else "0"
+        try:
+            mgr = hvd_checkpoint.CheckpointManager(
+                root, max_to_keep=2, rank=0, size=1)
+            lat = []
+            for s in range(steps):
+                t0 = time.perf_counter()
+                mgr.save(s, state, metadata={"step": s})
+                lat.append(time.perf_counter() - t0)
+                # Let the background persist land OUTSIDE the timed
+                # window: real checkpoints are steps apart, so the stall
+                # the loop pays is the snapshot, not the previous write
+                # (back-to-back saves would serialize on it and measure
+                # the disk, not the split).
+                mgr.drain()
+        finally:
+            if saved is None:
+                os.environ.pop("HVD_TPU_CKPT_ASYNC", None)
+            else:
+                os.environ["HVD_TPU_CKPT_ASYNC"] = saved
+            shutil.rmtree(root, ignore_errors=True)
+        return sorted(lat)[len(lat) // 2] * 1e3  # median, ms
+
+    sync_ms = run(async_mode=False)
+    stall_ms = run(async_mode=True)
+    print(json.dumps({
+        "metric": "checkpoint_stall_ms",
+        "value": round(stall_ms, 1),
+        "unit": "ms",
+        "vs_baseline": round(sync_ms / max(stall_ms, 1e-9), 1),
+        "checkpoint_sync_ms": round(sync_ms, 1),
+        "state_bytes": nbytes,
+    }))
+
+
 def overlap_plan_microbench() -> None:
     """Width-1 planner check, in the harness where the regression lived:
     lower a small training step over a ONE-device mesh and assert the
@@ -416,6 +490,8 @@ def main() -> None:
         eager_microbench()
     if os.environ.get("BENCH_SKIP_PLAN") != "1":
         overlap_plan_microbench()
+    if os.environ.get("BENCH_SKIP_CKPT") != "1":
+        checkpoint_bench()
     if os.environ.get("BENCH_SKIP_RESNET") == "1":
         return
     import jax

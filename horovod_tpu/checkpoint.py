@@ -19,14 +19,15 @@ import os
 import shutil
 import signal as _signal
 import threading
+import time
 import warnings
 from typing import Any, NamedTuple
 
 import jax
 import numpy as np
 
-from horovod_tpu import basics, faults, training
-from horovod_tpu.utils import manifest
+from horovod_tpu import basics, faults, replication, training
+from horovod_tpu.utils import env, manifest
 
 
 def _multiprocess_env() -> bool:
@@ -308,6 +309,25 @@ def _adapt_compression_state(raw, template):
     return jtu.tree_unflatten(treedef, out)
 
 
+# Counts payload reads served from DISK (orbax restores), so the peer-
+# replicated restore path can prove "zero disk reads" (tests pin it).
+# Directory listings / manifest parses are metadata, not payload reads,
+# and are deliberately not counted.
+_disk_read_count = 0
+
+
+def disk_read_count() -> int:
+    """Checkpoint payload reads served from disk since import (or the last
+    :func:`reset_disk_read_count`) — the instrument behind the
+    peer-restore acceptance test (docs/fault_tolerance.md)."""
+    return _disk_read_count
+
+
+def reset_disk_read_count() -> None:
+    global _disk_read_count
+    _disk_read_count = 0
+
+
 def restore(path: str | os.PathLike, template: Any | None = None,
             *, broadcast: bool = True, root_rank: int = 0) -> Any:
     """Load a checkpoint and (by default) broadcast it from ``root_rank`` so
@@ -323,9 +343,11 @@ def restore(path: str | os.PathLike, template: Any | None = None,
     automatically — see ``_adapt_compression_state``.
     """
     def read():
+        global _disk_read_count
         import orbax.checkpoint as ocp
 
         wait_pending()  # a pending background save must be visible to reads
+        _disk_read_count += 1
         p = os.path.abspath(os.fspath(path))
         with _lone_checkpointer() as ckptr:
             if template is not None:
@@ -472,6 +494,14 @@ def _jsonable(obj):
     return obj
 
 
+# Upper bound on the peer-restore agreement's wait loops.  Restore runs
+# right after a reconfiguration on a control plane that just proved itself
+# alive, so views and payloads normally arrive in milliseconds; the bound
+# only turns a cascading failure mid-restore into a clean abort instead of
+# a hang.
+_PEER_RESTORE_TIMEOUT_S = 120.0
+
+
 class CheckpointManager:
     """Preemption-safe step checkpointing with a completeness manifest.
 
@@ -482,6 +512,20 @@ class CheckpointManager:
     step.  The launcher's restart supervision reads the same manifest
     protocol (run.py) to point relaunched jobs at the newest complete
     step.
+
+    With ``HVD_TPU_CKPT_ASYNC=1`` a ``save`` is split into *snapshot*
+    (host copy + async orbax kick — the only part the train loop waits
+    for) and *persist* (a background thread waits for the payload to
+    land, writes ``_COMMIT``, prunes).  A persist failure (ENOSPC, torn
+    disk) leaves the step INVISIBLE and is surfaced via
+    :meth:`persist_error` — complete-or-invisible holds, training is
+    never torn down by checkpoint IO.
+
+    With ``HVD_TPU_CKPT_REPLICATE=1`` every save additionally ships the
+    snapshot to a neighbor rank's host memory over the control plane
+    (replication.py); :meth:`restore_latest` consults the in-memory
+    replica first and reads disk only when no epoch-valid replica at
+    least as new as the newest complete step survives.
 
     The reference contract is preserved: only rank 0 writes; restore is
     coordinated so every rank resumes from the same step even when the
@@ -504,6 +548,15 @@ class CheckpointManager:
         self._rank_override = rank
         self._size_override = size
         self._pending: list[tuple[int, dict | None]] = []
+        self._async = env.ckpt_async()
+        # _io_lock serializes directory surgery (save's rmtree/makedirs,
+        # commit, prune) between the caller and the persist thread.
+        self._io_lock = threading.Lock()
+        self._persist_cv = threading.Condition()
+        self._persist_q: list[tuple[int, dict | None]] = []
+        self._persist_thread: threading.Thread | None = None
+        self._persist_err: BaseException | None = None
+        self._last_committed = -1
         if self._my_rank() == 0:
             os.makedirs(self.directory, exist_ok=True)
         # Commit any in-flight background manifest before interpreter
@@ -523,16 +576,25 @@ class CheckpointManager:
     # -- writing ------------------------------------------------------------
 
     def save(self, step: int, state: Any, *, metadata: dict | None = None,
-             background: bool = False) -> None:
-        """Write ``state`` as checkpoint ``step``; no-op off rank 0.
+             background: bool | None = None) -> None:
+        """Write ``state`` as checkpoint ``step``; no-op off rank 0 (peer
+        replication, when enabled, still happens before the gate returns
+        — every rank's neighbor holds a current snapshot).
 
         ``background=True`` kicks the payload write to the orbax worker
         thread and defers the commit manifest until the write lands
         (next ``save``/``drain``/exit) — the checkpoint stays invisible
-        until it is real.  ``metadata`` is the resume record (step is
-        always included; add rng key, data offsets, ... for bit-exact
+        until it is real.  ``background=None`` (the default) defers to
+        ``HVD_TPU_CKPT_ASYNC``: in async mode the commit itself also
+        moves to the persist thread, so this call stalls the train loop
+        for the snapshot only.  ``metadata`` is the resume record (step
+        is always included; add rng key, data offsets, ... for bit-exact
         resume)."""
         if self._my_rank() != 0:
+            self._replicate(step, state, metadata)
+            return
+        if self._async:
+            self._save_async(step, state, metadata)
             return
         self._flush_pending()
         path = manifest.step_dir(self.directory, step)
@@ -542,13 +604,122 @@ class CheckpointManager:
             # with it, so readers never see a half-updated mix.
             shutil.rmtree(path)
         os.makedirs(path, exist_ok=True)
-        save(os.path.join(path, "state"), state, background=background,
+        save(os.path.join(path, "state"), state, background=bool(background),
              rank=0)
+        self._replicate(step, state, metadata)
         if background:
             self._pending.append((step, metadata))
         else:
             self._commit(step, metadata)
         self._prune()
+
+    def _save_async(self, step: int, state: Any,
+                    metadata: dict | None) -> None:
+        """The tentpole split: *snapshot* here (device→host copy at the
+        step barrier), *persist* on the background thread (payload write,
+        ``_COMMIT``, prune).  The train loop stalls for the memcpy only —
+        disk bandwidth never appears in the step time.  Orbax's async
+        checkpointer is deliberately NOT used here: it serializes host
+        (numpy) leaves synchronously before returning, which at multi-GB
+        states is the whole write."""
+        path = manifest.step_dir(self.directory, step)
+        if os.path.isdir(path):
+            # Restart replay of a step that may still be persisting: let
+            # every in-flight write land before tearing its directory down.
+            self._wait_persisted()
+            wait_pending()
+        with self._io_lock:
+            if os.path.isdir(path):
+                shutil.rmtree(path)
+            os.makedirs(path, exist_ok=True)
+        snap = jax.tree.map(self._host_snapshot, state)
+        self._replicate(step, snap, metadata)
+        limit = env.ckpt_staleness_steps()
+        with self._persist_cv:
+            if self._persist_thread is None \
+                    or not self._persist_thread.is_alive():
+                self._persist_thread = threading.Thread(
+                    target=self._persist_loop, name="hvd-ckpt-persist",
+                    daemon=True)
+                self._persist_thread.start()
+            # Bounded staleness as backpressure, not just an assertion:
+            # when the persist queue is already `limit` snapshots deep the
+            # disk has fallen behind, and absorbing more snapshots would
+            # grow host memory while widening the restore gap — stall the
+            # step barrier here until the writer catches up.
+            while limit and len(self._persist_q) >= limit:
+                self._persist_cv.wait(0.2)
+            self._persist_q.append((int(step), metadata, snap))
+            self._persist_cv.notify_all()
+
+    @staticmethod
+    def _host_snapshot(v):
+        """One leaf of the step-barrier snapshot: land device arrays on
+        host and copy every mutable host leaf, so the persist thread reads
+        buffers the training loop can no longer touch (donation, in-place
+        optimizer updates)."""
+        if isinstance(v, jax.Array):
+            return np.asarray(v)
+        if isinstance(v, np.ndarray):
+            return v.copy()
+        if isinstance(v, (int, float, complex, bool, str, bytes,
+                          type(None))):
+            return v
+        try:
+            return np.array(v, copy=True)
+        except Exception:
+            return v
+
+    def _persist_loop(self) -> None:
+        while True:
+            with self._persist_cv:
+                while not self._persist_q:
+                    self._persist_cv.wait(1.0)
+                step, md, snap = self._persist_q[0]
+            try:
+                path = manifest.step_dir(self.directory, step)
+                with self._io_lock:
+                    save(os.path.join(path, "state"), snap, rank=0)
+                    self._commit(step, md)
+                    self._prune()
+            except BaseException as exc:  # noqa: BLE001 - must not die
+                # A failed persist leaves the step INVISIBLE (no _COMMIT):
+                # complete-or-invisible holds and training is not torn
+                # down by checkpoint IO.  Surface via persist_error().
+                with self._persist_cv:
+                    self._persist_err = exc
+                warnings.warn(
+                    f"checkpoint step {step} failed to persist "
+                    f"({type(exc).__name__}: {exc}); it stays invisible "
+                    f"and restore falls back to the previous complete step")
+            finally:
+                with self._persist_cv:
+                    self._persist_q.pop(0)
+                    self._persist_cv.notify_all()
+
+    def _wait_persisted(self) -> None:
+        with self._persist_cv:
+            while self._persist_q:
+                self._persist_cv.wait(0.2)
+
+    def _replicate(self, step: int, state: Any,
+                   metadata: dict | None) -> None:
+        if replication.enabled():
+            replication.put(int(step), state,
+                            dict(_jsonable(metadata)) if metadata else {})
+
+    def persist_error(self) -> BaseException | None:
+        """The most recent background-persist failure (ENOSPC and
+        friends), or None.  The failed step stayed invisible."""
+        with self._persist_cv:
+            return self._persist_err
+
+    def last_committed_step(self) -> int:
+        """Newest step this manager committed in this process (-1 before
+        any) — the cheap bounded-staleness probe the checkpoint soak
+        asserts against (``HVD_TPU_CKPT_STALENESS_STEPS``)."""
+        with self._persist_cv:
+            return self._last_committed
 
     def drain(self) -> None:
         """Block until every in-flight save is durable AND committed.
@@ -559,6 +730,7 @@ class CheckpointManager:
         if self._my_rank() != 0:
             return
         self._flush_pending()
+        self._wait_persisted()
 
     def _flush_pending(self) -> None:
         if not self._pending:
@@ -571,13 +743,19 @@ class CheckpointManager:
     def _commit(self, step: int, metadata: dict | None) -> None:
         path = manifest.step_dir(self.directory, step)
         doc = dict(_jsonable(metadata) if metadata else {})
+        if faults.on_checkpoint_persist(path, step):
+            return  # injector hijacked the commit (torn manifest)
         manifest.write_commit(path, step, doc)
+        with self._persist_cv:
+            self._last_committed = max(self._last_committed, step)
         faults.on_checkpoint_committed(path, step)
 
     def _prune(self) -> None:
         committed = manifest.complete_steps(self.directory)
         keep = set(committed[-self.max_to_keep:])
         pending = {s for s, _ in self._pending}
+        with self._persist_cv:
+            pending |= {e[0] for e in self._persist_q}
         newest = committed[-1] if committed else None
         for entry in os.listdir(self.directory):
             step = manifest.parse_step(entry)
@@ -609,7 +787,16 @@ class CheckpointManager:
         Coordinated like :func:`restore`: rank 0 picks the step (trying a
         real read, so a payload that fails to deserialize is skipped with
         a warning), broadcasts the verdict, and every rank restores the
-        agreed step so the job resumes in lockstep."""
+        agreed step so the job resumes in lockstep.
+
+        With ``HVD_TPU_CKPT_REPLICATE=1`` a peer-replicated in-memory
+        snapshot from the CURRENT membership epoch is preferred over disk
+        whenever it is at least as new as the newest complete step —
+        zero payload reads from disk (``disk_read_count``); stale-epoch
+        replicas are rejected and this falls through to the disk path."""
+        peer = self._restore_from_peers(broadcast=broadcast)
+        if peer is not None:
+            return peer
         coordinated = broadcast and self._my_size() > 1
         if not coordinated:
             picked = self._pick_restorable(template)
@@ -629,6 +816,114 @@ class CheckpointManager:
         step, md = header
         state = restore(self._state_path(step), template, broadcast=True)
         return ElasticCheckpoint(step, state, md)
+
+    def _restore_from_peers(self, *,
+                            broadcast: bool = True) -> ElasticCheckpoint | None:
+        """Disk-free restore from a peer-replicated host-memory snapshot.
+
+        Replicas are keyed by the membership epoch the control plane
+        stamped into their SHARD_PUT frames; only replicas from the
+        engine's CURRENT epoch are eligible (a RECONFIG re-stamps
+        survivors via ``replication.bump_epoch``, so anything a departed
+        rank pushed under the old epoch is rejected here).  The replica
+        must also be at least as new as the newest complete step on
+        disk — otherwise disk wins and this returns None."""
+        if not replication.enabled():
+            return None
+        from horovod_tpu.core import engine as _core_engine
+        eng = _core_engine.peek_engine()
+        if eng is None:
+            return None
+        replication.drain(eng)
+        entry = replication.best(eng.epoch)
+        local = entry.step if entry is not None else -1
+        # Coordination is keyed on the ENGINE job, not the manager's
+        # rank/size overrides: elastic workers run one manager per process
+        # (size_override=1, only rank 0 writes disk) yet must still agree
+        # on ONE restore step — with async persist the survivors' local
+        # views (replica inbox, commit lag) legitimately differ, and
+        # picking independently desynchronizes the replayed collectives.
+        coordinated = broadcast and eng.size > 1
+        if not coordinated:
+            # Engine-only elastic worker (size=1 manager): weigh the
+            # local replica against the local filesystem view only.
+            if entry is None:
+                return None
+            self.drain()
+            disk = self.latest_step()
+            if disk is not None and int(disk) > entry.step:
+                return None
+            doc = replication.decode(entry)
+            return ElasticCheckpoint(int(doc["step"]), doc["state"],
+                                     doc.get("metadata") or {})
+        # Multi-rank agreement.  The engine-only elastic workers have NO
+        # cross-process data plane (their executor is identity; enqueue()
+        # only negotiates), so the agreement rides the same control-plane
+        # SHARD relay the replicas travelled on: every rank announces its
+        # best epoch-valid replica step as a view frame, each rank reaches
+        # the SAME decision from the same exchanged views, and the newest
+        # holder ships the winning snapshot to ranks that lack it.  Without
+        # this agreement the survivors pick restore points independently —
+        # with async persist their local views (replica inbox, commit lag)
+        # legitimately differ, and divergent resume steps desynchronize
+        # the replayed collectives.
+        #
+        # Every rank drains its OWN manager before announcing (a no-op off
+        # the disk writer): once all views are in, every writer's commits
+        # have landed and the shared-directory view below is stable.
+        self.drain()
+        replication.send_view(local, eng)
+        deadline = time.monotonic() + _PEER_RESTORE_TIMEOUT_S
+        while True:
+            replication.drain(eng)
+            views = replication.views(eng.epoch)
+            if len(views) >= eng.size - 1:
+                break
+            self._check_restore_liveness(eng, deadline, "peer views")
+            time.sleep(0.01)
+        steps = [int(local) if r == eng.rank else int(views.get(r, -1))
+                 for r in range(eng.size)]
+        best_step = max(steps)
+        disk = self.latest_step()
+        disk = -1 if disk is None else int(disk)
+        if best_step < 0 or disk > best_step:
+            # No epoch-valid replica anywhere, or disk is strictly newer:
+            # every rank computes this from the same views and the same
+            # (now stable) directory, so all take the disk path together.
+            return None
+        holder = steps.index(best_step)
+        if eng.rank == holder:
+            for r in range(eng.size):
+                if r != eng.rank and steps[r] < best_step:
+                    eng.shard_put(r, best_step, entry.payload)
+        if entry is None or entry.step < best_step:
+            while True:
+                replication.drain(eng)
+                entry = replication.best(eng.epoch)
+                if entry is not None and entry.step >= best_step:
+                    break
+                self._check_restore_liveness(eng, deadline,
+                                             "replica payload")
+                time.sleep(0.01)
+        doc = replication.decode(entry)
+        return ElasticCheckpoint(int(doc["step"]), doc["state"],
+                                 doc.get("metadata") or {})
+
+    @staticmethod
+    def _check_restore_liveness(eng, deadline: float, what: str) -> None:
+        """Bound the peer-restore wait loops: a membership change surfaces
+        as MembershipChanged (the caller reconfigures and retries at the
+        new epoch); a silent stall past the deadline aborts the rank so
+        launcher supervision can take over instead of hanging the job."""
+        from horovod_tpu.core import engine as _core_engine
+        if eng.resize_event() is not None:
+            raise _core_engine.MembershipChanged(
+                "membership changed during peer-replica restore; "
+                "reconfigure and retry")
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"peer-replica restore: {what} did not arrive within "
+                f"{_PEER_RESTORE_TIMEOUT_S}s")
 
     def _state_path(self, step: int) -> str:
         return os.path.join(manifest.step_dir(self.directory, step), "state")

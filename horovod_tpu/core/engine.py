@@ -151,6 +151,16 @@ def _load_library() -> ctypes.CDLL:
                                      ctypes.c_int]
     lib.hvd_resize_ack.restype = None
     lib.hvd_resize_ack.argtypes = [ctypes.c_void_p]
+    lib.hvd_shard_put.restype = ctypes.c_int
+    lib.hvd_shard_put.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                  ctypes.c_longlong, ctypes.c_char_p,
+                                  ctypes.c_longlong]
+    lib.hvd_shard_poll.restype = ctypes.c_int
+    lib.hvd_shard_poll.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_int]
+    lib.hvd_shard_ack_poll.restype = ctypes.c_int
+    lib.hvd_shard_ack_poll.argtypes = [ctypes.c_void_p,
+                                       ctypes.POINTER(ctypes.c_longlong)]
     lib.hvd_coord_state.restype = ctypes.c_int
     lib.hvd_coord_state.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                     ctypes.c_int]
@@ -544,6 +554,41 @@ class NativeEngine:
         reconfig-timeout fallback exit down so this process can re-form the
         engine in place (called by ``elastic.reconfigure``)."""
         self._lib.hvd_resize_ack(self._ptr)
+
+    # -- peer-replicated checkpoint shards (docs/fault_tolerance.md
+    # "Async & peer-replicated checkpointing") -----------------------------
+
+    def shard_put(self, target_rank: int, step: int, payload: bytes) -> bool:
+        """Push an opaque checkpoint shard toward ``target_rank``'s host
+        memory over the control plane (relayed through the coordinator in
+        the star topology).  Non-blocking on the inbox side; returns False
+        on single-process jobs (no peers) or when the send failed."""
+        return bool(self._lib.hvd_shard_put(self._ptr, target_rank, step,
+                                            payload, len(payload)))
+
+    def shard_poll(self) -> tuple[int, int, int, bytes] | None:
+        """Pop the next shard a peer replicated into this rank's inbox:
+        ``(owner_rank, step, epoch, payload)``; ``None`` when empty."""
+        buf = ctypes.create_string_buffer(1 << 16)
+        n = self._lib.hvd_shard_poll(self._ptr, buf, len(buf))
+        if n < -1:
+            buf = ctypes.create_string_buffer(-n + 16)
+            n = self._lib.hvd_shard_poll(self._ptr, buf, len(buf))
+        if n <= 0:
+            return None
+        raw = buf.raw[:n]
+        owner, step, epoch, ln = struct.unpack_from("<iqqq", raw, 0)
+        payload = raw[28:28 + ln]
+        return (owner, step, epoch, payload)
+
+    def shard_acks(self) -> list[tuple[int, int, int, int]]:
+        """Drain the control-plane acks for shards this rank pushed:
+        ``[(owner_rank, target_rank, step, epoch), ...]``."""
+        out = []
+        ack = (ctypes.c_longlong * 4)()
+        while self._lib.hvd_shard_ack_poll(self._ptr, ack):
+            out.append((int(ack[0]), int(ack[1]), int(ack[2]), int(ack[3])))
+        return out
 
     def coord_state(self) -> dict | None:
         """The last coordinator-state delta this rank has seen

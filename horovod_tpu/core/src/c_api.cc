@@ -329,6 +329,57 @@ int hvd_coord_state(void* e, char* buf, int buflen) {
   return static_cast<int>(w.buf.size());
 }
 
+// Async peer-replicated checkpointing (docs/fault_tolerance.md "Async &
+// peer-replicated checkpointing").  hvd_shard_put pushes `len` opaque
+// bytes toward target_rank's host memory over the control plane (relayed
+// through the coordinator); returns 1 on acceptance, 0 when the plane has
+// no peers or the send failed.
+int hvd_shard_put(void* e, int target_rank, long long step, const char* buf,
+                  long long len) {
+  if (buf == nullptr || len < 0) return 0;
+  std::string payload(buf, static_cast<size_t>(len));
+  return static_cast<Engine*>(e)->ShardPutSend(target_rank, step, payload)
+             ? 1
+             : 0;
+}
+
+// Pop the next shard a peer replicated into this rank's inbox, serialized
+// as {i32 owner_rank, i64 step, i64 epoch, i64 payload_len, payload}.
+// Returns bytes written, 0 when the inbox is empty, or -needed-1 when
+// buflen is too small (grow-and-retry convention — the shard stays queued).
+int hvd_shard_poll(void* e, char* buf, int buflen) {
+  auto* eng = static_cast<Engine*>(e);
+  hvd::ShardPut shard;
+  if (!eng->ShardPoll(&shard)) return 0;
+  Writer w;
+  w.i32(shard.owner_rank);
+  w.i64(shard.step);
+  w.i64(shard.epoch);
+  w.i64(static_cast<int64_t>(shard.payload.size()));
+  w.buf.append(shard.payload);
+  if (static_cast<int>(w.buf.size()) > buflen) {
+    int needed = static_cast<int>(w.buf.size());
+    // Hand the shard back; the caller grows its buffer and retries.
+    eng->ShardRequeue(std::move(shard));
+    return -needed - 1;
+  }
+  std::memcpy(buf, w.buf.data(), w.buf.size());
+  return static_cast<int>(w.buf.size());
+}
+
+// Pop the next control-plane ack for a shard this rank pushed: fills
+// out[0..3] = {owner_rank, target_rank, step, epoch}.  Returns 1, or 0
+// when no ack is queued.
+int hvd_shard_ack_poll(void* e, long long* out) {
+  hvd::ShardAck ack;
+  if (!static_cast<Engine*>(e)->ShardAckPoll(&ack)) return 0;
+  out[0] = ack.owner_rank;
+  out[1] = ack.target_rank;
+  out[2] = ack.step;
+  out[3] = ack.epoch;
+  return 1;
+}
+
 // Python acknowledges the resize: the stopped engine may be destroyed and
 // re-formed under the new membership; the reconfig-timeout fallback exit
 // stands down.

@@ -682,6 +682,133 @@ void TcpControlPlane::SyncCoordState(const CoordState& state) {
   SendTypedFrame(fd, FrameType::STATE, payload, standby_rank);
 }
 
+// Replicas a reader stopped polling must not balloon the host heap: past
+// the cap the oldest entry is dropped (a newer shard supersedes it anyway).
+constexpr size_t kShardInboxCap = 64;
+
+bool TcpControlPlane::SendShard(const ShardPut& shard) {
+  if (failed_.load()) return false;
+  std::string payload;
+  Serialize(shard, &payload);
+  if (payload.size() > kMaxFrameBytes) return false;
+  if (!coordinator_) {
+    // Worker leg of the star: the coordinator relays to the target and
+    // answers with the SHARD_ACK.
+    return sock_ >= 0 &&
+           SendTypedFrame(sock_, FrameType::SHARD_PUT, payload, 0);
+  }
+  // Coordinator-originated shard: deliver straight to the target (or into
+  // its own inbox) and self-ack — the plane accepted it by definition.
+  bool accepted = false;
+  if (shard.target_rank == rank_) {
+    std::lock_guard<std::mutex> l(state_mu_);
+    shard_inbox_.push_back(shard);
+    if (shard_inbox_.size() > kShardInboxCap) shard_inbox_.pop_front();
+    accepted = true;
+  } else {
+    int idx = shard.target_rank - 1;
+    if (idx < 0 || static_cast<size_t>(idx) >= worker_fds_.size()) {
+      return false;
+    }
+    int fd = worker_fds_[static_cast<size_t>(idx)];
+    if (fd < 0) return false;
+    accepted =
+        SendTypedFrame(fd, FrameType::SHARD_PUT, payload, shard.target_rank);
+  }
+  if (accepted) {
+    ShardAck ack;
+    ack.owner_rank = shard.owner_rank;
+    ack.target_rank = shard.target_rank;
+    ack.step = shard.step;
+    ack.epoch = shard.epoch;
+    std::lock_guard<std::mutex> l(state_mu_);
+    shard_acks_.push_back(ack);
+    if (shard_acks_.size() > kShardInboxCap) shard_acks_.pop_front();
+  }
+  return accepted;
+}
+
+bool TcpControlPlane::PollShard(ShardPut* out) {
+  std::lock_guard<std::mutex> l(state_mu_);
+  if (shard_inbox_.empty()) return false;
+  *out = std::move(shard_inbox_.front());
+  shard_inbox_.pop_front();
+  return true;
+}
+
+void TcpControlPlane::RequeueShard(ShardPut&& shard) {
+  std::lock_guard<std::mutex> l(state_mu_);
+  shard_inbox_.push_front(std::move(shard));
+}
+
+bool TcpControlPlane::PollShardAck(ShardAck* out) {
+  std::lock_guard<std::mutex> l(state_mu_);
+  if (shard_acks_.empty()) return false;
+  *out = shard_acks_.front();
+  shard_acks_.pop_front();
+  return true;
+}
+
+bool TcpControlPlane::HandleShardFrame(FrameType t, const std::string& body,
+                                       int from_rank) {
+  if (t == FrameType::SHARD_ACK) {
+    ShardAck ack;
+    if (!Deserialize(body.data(), body.size(), &ack)) {
+      RecordFailure(from_rank, "frame_corrupt",
+                    "undecodable SHARD_ACK frame from rank " +
+                        std::to_string(from_rank));
+      return false;
+    }
+    std::lock_guard<std::mutex> l(state_mu_);
+    shard_acks_.push_back(ack);
+    if (shard_acks_.size() > kShardInboxCap) shard_acks_.pop_front();
+    return true;
+  }
+  ShardPut shard;
+  if (!Deserialize(body.data(), body.size(), &shard)) {
+    RecordFailure(from_rank, "frame_corrupt",
+                  "undecodable SHARD_PUT frame from rank " +
+                      std::to_string(from_rank));
+    return false;
+  }
+  ShardAck ack;
+  ack.owner_rank = shard.owner_rank;
+  ack.target_rank = shard.target_rank;
+  ack.step = shard.step;
+  ack.epoch = shard.epoch;
+  bool accepted = false;
+  if (coordinator_ && shard.target_rank != rank_) {
+    // Relay leg of the star: forward to the target worker.  The ack means
+    // "accepted by the control plane", not end-to-end delivery — a dead
+    // target just loses its replica (the owner still has disk).
+    int idx = shard.target_rank - 1;
+    if (idx >= 0 && static_cast<size_t>(idx) < worker_fds_.size() &&
+        worker_fds_[static_cast<size_t>(idx)] >= 0) {
+      std::string payload;
+      Serialize(shard, &payload);
+      accepted = SendTypedFrame(worker_fds_[static_cast<size_t>(idx)],
+                                FrameType::SHARD_PUT, payload,
+                                shard.target_rank);
+    }
+  } else {
+    std::lock_guard<std::mutex> l(state_mu_);
+    shard_inbox_.push_back(std::move(shard));
+    if (shard_inbox_.size() > kShardInboxCap) shard_inbox_.pop_front();
+    accepted = true;
+  }
+  if (coordinator_ && accepted) {
+    int oidx = from_rank - 1;
+    if (oidx >= 0 && static_cast<size_t>(oidx) < worker_fds_.size() &&
+        worker_fds_[static_cast<size_t>(oidx)] >= 0) {
+      std::string payload;
+      Serialize(ack, &payload);
+      SendTypedFrame(worker_fds_[static_cast<size_t>(oidx)],
+                     FrameType::SHARD_ACK, payload, from_rank);
+    }
+  }
+  return true;
+}
+
 bool TcpControlPlane::SendTypedFrame(int fd, FrameType type,
                                      const std::string& payload,
                                      int peer_rank) {
@@ -841,6 +968,13 @@ bool TcpControlPlane::RecvDataFrame(int fd, int peer_rank, FrameType expect,
         coord_state_ = state;
         has_coord_state_ = true;
       }
+      continue;
+    }
+    if (t == FrameType::SHARD_PUT || t == FrameType::SHARD_ACK) {
+      // Peer-replicated checkpoint shards interleave with the response
+      // stream like heartbeats; an undecodable one recorded a structured
+      // frame_corrupt failure.
+      if (!HandleShardFrame(t, body, peer_rank)) return false;
       continue;
     }
     if (t == FrameType::ABORT) {
@@ -1204,6 +1338,14 @@ bool TcpControlPlane::Gather(const RequestList& own,
         NoteRx(wrank);
         if (t == FrameType::HEARTBEAT) {
           f = FrameState{};  // liveness only; keep draining this fd
+          continue;
+        }
+        if (t == FrameType::SHARD_PUT || t == FrameType::SHARD_ACK) {
+          // Checkpoint-shard relay (docs/fault_tolerance.md "Async &
+          // peer-replicated checkpointing"): forward/accept and keep
+          // draining — these interleave with REQUEST traffic.
+          if (!HandleShardFrame(t, f.buf, wrank)) return false;
+          f = FrameState{};
           continue;
         }
         if (t != FrameType::REQUEST) {

@@ -91,6 +91,23 @@ class ControlPlane {
   // Coordinator side: stream the authoritative-only state to the standby
   // (best effort; a send failure is a peer failure like any other).
   virtual void SyncCoordState(const CoordState& /*state*/) {}
+
+  // Async peer-replicated checkpointing (docs/fault_tolerance.md "Async &
+  // peer-replicated checkpointing").  SendShard pushes one checkpoint
+  // shard toward shard.target_rank — the star topology has no
+  // worker-to-worker sockets, so worker-originated shards ride SHARD_PUT
+  // frames to the coordinator, which relays them to the target (or into
+  // its own inbox) and answers the owner with a SHARD_ACK.  PollShard
+  // pops the next shard a peer replicated into this plane's inbox;
+  // PollShardAck pops the next acknowledgement for a shard this rank
+  // sent.  All non-blocking; the loopback plane has no peers to
+  // replicate to.
+  virtual bool SendShard(const ShardPut& /*shard*/) { return false; }
+  virtual bool PollShard(ShardPut* /*out*/) { return false; }
+  // Return a polled shard to the front of the inbox (the C-ABI
+  // grow-and-retry path: the caller's buffer was too small).
+  virtual void RequeueShard(ShardPut&& /*shard*/) {}
+  virtual bool PollShardAck(ShardAck* /*out*/) { return false; }
 };
 
 // Single-process transport: Exchange/Gather/Broadcast are pass-throughs.
@@ -163,6 +180,11 @@ class TcpControlPlane : public ControlPlane {
   bool GetStandby(StandbyInfo* out) const override;
   bool GetCoordState(CoordState* out) const override;
   void SyncCoordState(const CoordState& state) override;
+
+  bool SendShard(const ShardPut& shard) override;
+  bool PollShard(ShardPut* out) override;
+  void RequeueShard(ShardPut&& shard) override;
+  bool PollShardAck(ShardAck* out) override;
   // Worker: port of the pre-bound succession listener (0 = none).  The
   // engine surfaces it as the elastic worker's bound_port so Python can
   // re-bind the same endpoint when this rank is promoted.
@@ -195,6 +217,11 @@ class TcpControlPlane : public ControlPlane {
                       int peer_rank);
   bool RecvDataFrame(int fd, int peer_rank, FrameType expect,
                      std::string* payload);
+  // Shard-frame demux shared by the worker's RecvDataFrame and the
+  // coordinator's Gather: decode a SHARD_PUT/SHARD_ACK body, relay or
+  // enqueue it, and generate the coordinator-side SHARD_ACK.  Returns
+  // false on an undecodable body (recorded as frame_corrupt).
+  bool HandleShardFrame(FrameType t, const std::string& body, int from_rank);
   void RecordFailure(int peer_rank, const char* cause, std::string detail);
   void RecordAbort(const PeerFailureReport& report);
   void RecordReconfig(const ReconfigInfo& info);
@@ -240,6 +267,12 @@ class TcpControlPlane : public ControlPlane {
   // Standby worker: last replicated coordinator state (STATE frames).
   CoordState coord_state_;
   bool has_coord_state_ = false;
+  // Peer-replication inboxes (guarded by state_mu_): shards peers pushed
+  // to this rank's host memory, and control-plane acks for shards this
+  // rank pushed.  Bounded: the oldest entry is dropped past the cap so a
+  // reader that stopped polling cannot balloon the host heap.
+  std::deque<ShardPut> shard_inbox_;
+  std::deque<ShardAck> shard_acks_;
 
   uint8_t wire_version_ = kWireVersion;  // HVD_TPU_WIRE_VERSION override
   WireFaultSpec fault_;

@@ -974,6 +974,30 @@ PeerFailureReport Engine::FailureReport() {
   return failure_;
 }
 
+bool Engine::ShardPutSend(int32_t target_rank, int64_t step,
+                          const std::string& payload) {
+  if (!control_ || stopped_.load()) return false;
+  ShardPut shard;
+  shard.owner_rank = opts_.rank;
+  shard.target_rank = target_rank;
+  shard.step = step;
+  shard.epoch = opts_.epoch;
+  shard.payload = payload;
+  return control_->SendShard(shard);
+}
+
+bool Engine::ShardPoll(ShardPut* out) {
+  return control_ && control_->PollShard(out);
+}
+
+void Engine::ShardRequeue(ShardPut&& shard) {
+  if (control_) control_->RequeueShard(std::move(shard));
+}
+
+bool Engine::ShardAckPoll(ShardAck* out) {
+  return control_ && control_->PollShardAck(out);
+}
+
 bool Engine::PollHandle(int64_t handle) {
   std::lock_guard<std::mutex> l(mu_);
   auto it = handles_.find(handle);

@@ -215,6 +215,20 @@ class Engine {
   // ControlPlane::CloseListener.
   void DetachListener();
 
+  // Async peer-replicated checkpointing (docs/fault_tolerance.md "Async &
+  // peer-replicated checkpointing"): push one opaque checkpoint shard
+  // toward target_rank's host memory over the control plane (relayed
+  // through the coordinator in the star topology), poll shards peers
+  // pushed to this rank, and poll the control-plane acks for shards this
+  // rank pushed.  All non-blocking and thread-safe (the control plane's
+  // own locks); false on single-process (loopback) jobs, which have no
+  // peers to replicate to.
+  bool ShardPutSend(int32_t target_rank, int64_t step,
+                    const std::string& payload);
+  bool ShardPoll(ShardPut* out);
+  void ShardRequeue(ShardPut&& shard);  // undo a poll (buffer too small)
+  bool ShardAckPoll(ShardAck* out);
+
   // Handle table (reference torch/handle_manager.{h,cc}).
   bool PollHandle(int64_t handle);                 // true = done
   // Block until the handle completes (condvar wait, not a poll loop).

@@ -403,4 +403,45 @@ bool Deserialize(const char* data, size_t len, CoordState* out) {
   return !r.fail;
 }
 
+void Serialize(const ShardPut& in, std::string* out) {
+  Writer w{out};
+  w.i32(in.owner_rank);
+  w.i32(in.target_rank);
+  w.i64(in.step);
+  w.i64(in.epoch);
+  // Shard payloads are checkpoint-sized: length-prefixed raw bytes bounded
+  // by what the frame actually carries, not the kMaxString name bound.
+  w.i64(static_cast<int64_t>(in.payload.size()));
+  w.raw(in.payload.data(), in.payload.size());
+}
+
+bool Deserialize(const char* data, size_t len, ShardPut* out) {
+  Reader r{data, len};
+  out->owner_rank = r.i32();
+  out->target_rank = r.i32();
+  out->step = r.i64();
+  out->epoch = r.i64();
+  int64_t n = r.i64();
+  if (r.fail || n < 0 || static_cast<size_t>(n) > r.left) return false;
+  out->payload.assign(r.p, static_cast<size_t>(n));
+  return true;
+}
+
+void Serialize(const ShardAck& in, std::string* out) {
+  Writer w{out};
+  w.i32(in.owner_rank);
+  w.i32(in.target_rank);
+  w.i64(in.step);
+  w.i64(in.epoch);
+}
+
+bool Deserialize(const char* data, size_t len, ShardAck* out) {
+  Reader r{data, len};
+  out->owner_rank = r.i32();
+  out->target_rank = r.i32();
+  out->step = r.i64();
+  out->epoch = r.i64();
+  return !r.fail;
+}
+
 }  // namespace hvd

@@ -167,6 +167,11 @@ enum class FrameType : uint8_t {
                   // (docs/fault_tolerance.md "Coordinator failover")
   STATE = 11,     // CoordState: coordinator -> standby delta replication of
                   // the authoritative-only coordinator state
+  SHARD_PUT = 12,  // ShardPut: one rank's checkpoint shard pushed to a peer's
+                   // host memory, relayed through the coordinator star
+                   // (docs/fault_tolerance.md "Async & peer-replicated
+                   // checkpointing")
+  SHARD_ACK = 13,  // ShardAck: the control plane accepted/relayed the shard
 };
 
 // 16-byte little-endian header preceding every frame payload.  ``flags``
@@ -282,5 +287,38 @@ struct CoordState {
 
 void Serialize(const CoordState& in, std::string* out);
 bool Deserialize(const char* data, size_t len, CoordState* out);
+
+// One rank's checkpoint shard replicated into a peer's host memory
+// (docs/fault_tolerance.md "Async & peer-replicated checkpointing").  The
+// star topology has no worker-to-worker sockets, so SHARD_PUT frames are
+// relayed through the coordinator: owner -> coordinator -> target.  The
+// epoch stamps the membership the shard was cut under; a restore rejects
+// replicas from any other epoch (stale membership = stale sharding).
+// ``payload`` is an opaque Python-side blob (pickled host arrays), bounded
+// only by kMaxFrameBytes.
+struct ShardPut {
+  int32_t owner_rank = -1;   // the rank whose state this is
+  int32_t target_rank = -1;  // the peer holding the replica
+  int64_t step = -1;         // training step the shard snapshots
+  int64_t epoch = 0;         // membership epoch at snapshot time
+  std::string payload;
+};
+
+void Serialize(const ShardPut& in, std::string* out);
+bool Deserialize(const char* data, size_t len, ShardPut* out);
+
+// Control-plane acknowledgement for a ShardPut: sent back to the owner when
+// the coordinator accepts the shard for relay (or into its own inbox), so
+// the owner's persist thread can bound replication lag without end-to-end
+// round trips.
+struct ShardAck {
+  int32_t owner_rank = -1;
+  int32_t target_rank = -1;
+  int64_t step = -1;
+  int64_t epoch = 0;
+};
+
+void Serialize(const ShardAck& in, std::string* out);
+bool Deserialize(const char* data, size_t len, ShardAck* out);
 
 }  // namespace hvd

@@ -65,6 +65,7 @@ import dataclasses
 import os
 import socket
 import struct
+import threading
 import time
 import zlib
 from typing import Callable, NamedTuple
@@ -192,6 +193,13 @@ def reconfigure(eng=None) -> ResizeEvent:
     # Stand the native reconfig-timeout fallback down FIRST: from here on
     # this process owns the recovery.
     eng.resize_ack()
+    # Absorb any checkpoint shards still sitting in the native inbox into
+    # the process-global host-memory store NOW — the inbox dies with the
+    # old engine, and a survivor may need the dead rank's replica for the
+    # disk-free restore that follows this reconfiguration.
+    from horovod_tpu import replication as _replication
+
+    _replication.drain(eng)
     ctor = dict(eng._ctor)
     if ev.new_rank == 0:
         # The coordinator re-binds its previous effective port (it may have
@@ -231,6 +239,19 @@ def reconfigure(eng=None) -> ResizeEvent:
     try:
         new_eng = _engine_mod.NativeEngine(
             ev.new_rank, ev.new_size, epoch=ev.epoch, **ctor)
+    except Exception as exc:
+        # The re-rendezvous failed (a split-brain loser dialing a standby
+        # that never promoted, a membership that changed again mid-form,
+        # an expired reconfig budget): this process cannot recover in
+        # place, so it takes the same road as an expelled rank — a
+        # MembershipChanged the caller can log, with the restartable exit
+        # already scheduled behind it so the launcher's full-restart
+        # supervision relaunches us instead of seeing a generic crash.
+        _schedule_restartable_exit()
+        raise MembershipChanged(
+            f"in-place reconfiguration to epoch {ev.epoch} "
+            f"(rank {ev.new_rank}/{ev.new_size}) failed: {exc}; falling "
+            f"back to the restartable full-restart path") from exc
     finally:
         if prev_budget is None:
             os.environ.pop("HVD_TPU_CONNECT_TIMEOUT", None)
@@ -245,6 +266,14 @@ def reconfigure(eng=None) -> ResizeEvent:
     from horovod_tpu import basics as _basics
 
     _basics._apply_resize(ev.new_rank, ev.new_size)
+    # Peer-replicated checkpoint shards held in host memory stay valid
+    # across a reconfiguration THIS process participated in: re-stamp them
+    # to the new epoch so a disk-free restore can still use them.  A
+    # straggler that missed the reconfig never gets here, so its stale
+    # stamps are rejected (replication.best) and it restores from disk.
+    from horovod_tpu import replication as _replication
+
+    _replication.bump_epoch(ev.epoch)
     if ev.new_rank == 0:
         # The (possibly newly promoted) coordinator republishes its
         # endpoint so late joiners and the launcher's single-rank relaunch
@@ -258,6 +287,25 @@ def reconfigure(eng=None) -> ResizeEvent:
     for cb in _callbacks:
         cb(ev)
     return ev
+
+
+def _schedule_restartable_exit() -> None:
+    """Mirror the native plane's abort-grace contract for failures that
+    happen BETWEEN engines (the old plane is torn down, the new one never
+    formed — nothing native is left to schedule the exit): give the caller
+    ``HVD_TPU_ABORT_GRACE_MS`` to log its structured report, then take the
+    restartable exit so supervision relaunches this rank.  Negative grace
+    keeps the native report-only semantics (never exit)."""
+    grace_ms = env.abort_grace_ms()
+    if grace_ms < 0:
+        return
+
+    def _die():
+        time.sleep(grace_ms / 1000.0)
+        os._exit(env.stall_abort_exit_code())
+
+    threading.Thread(target=_die, name="hvd-restartable-exit",
+                     daemon=True).start()
 
 
 def _publish_coordinator(host: str, port: int, epoch: int) -> None:

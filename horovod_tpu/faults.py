@@ -22,6 +22,20 @@ Injectors (all opt-in; absent env == no faults):
 * ``HVD_TPU_FAULT_CORRUPT_STEP`` — after checkpoint ``step`` commits,
   rank 0 overwrites part of its payload with garbage (bit-rot / torn
   upload); proves restore falls back to the previous complete step.
+* ``HVD_TPU_FAULT_PERSIST_KILL_STEP`` — rank 0 dies (SIGKILL) during the
+  persist of checkpoint ``step``: after the payload is durable but before
+  the ``_COMMIT`` manifest exists — the widest crash window the async
+  persist thread (checkpoint.CheckpointManager) opens.  The step must
+  stay invisible and restore must fall back to the previous complete one.
+* ``HVD_TPU_FAULT_TORN_MANIFEST_STEP`` — the commit of checkpoint
+  ``step`` leaves a TORN ``_COMMIT`` (half the JSON), simulating a
+  non-atomic filesystem tearing the manifest mid-write; readers must
+  treat the step as incomplete (utils/manifest.py parses, not stats).
+* ``HVD_TPU_FAULT_ENOSPC_STEP`` — the commit of checkpoint ``step``
+  raises ``ENOSPC``; the persist path must surface the error without
+  crashing training, and the step stays invisible.
+* ``HVD_TPU_FAULT_SLOW_DISK_MS`` — every commit gains this much latency,
+  the slow-NFS case the async persist thread exists to hide.
 * ``HVD_TPU_FAULT_WIRE_{DROP,CORRUPT,PARTITION,HALFCLOSE}`` =
   ``"<rank>[:<frame>][@<epoch>]"`` — wire-level chaos against the TCP
   control plane (executed natively in core/src/controller.cc; parsed here
@@ -57,7 +71,9 @@ Injectors (all opt-in; absent env == no faults):
 
 Hooks: training loops call :func:`step` once per step (wired through
 ``training.elastic_loop`` and ``callbacks.PreemptionCheckpointCallback``);
-``checkpoint.CheckpointManager`` calls :func:`on_checkpoint_committed`.
+``checkpoint.CheckpointManager`` calls :func:`on_checkpoint_persist`
+right before each ``_COMMIT`` write and :func:`on_checkpoint_committed`
+right after.
 Tests and bench.py may bypass env parsing with :func:`install`.
 
 jax-free by design: the injectors must work in processes that never
@@ -94,6 +110,10 @@ class FaultPlan:
     delay_step: int | None = None
     delay_ms: float = 500.0
     corrupt_step: int | None = None
+    persist_kill_step: int | None = None
+    torn_manifest_step: int | None = None
+    enospc_step: int | None = None
+    slow_disk_ms: float | None = None
     wire_drop: tuple[int, int, int] | None = None
     wire_corrupt: tuple[int, int, int] | None = None
     wire_partition: tuple[int, int, int] | None = None
@@ -103,7 +123,9 @@ class FaultPlan:
     def any_active(self) -> bool:
         return any(v is not None for v in (
             self.kill_rank, self.stall_rank, self.delay_rank,
-            self.corrupt_step, self.wire_drop, self.wire_corrupt,
+            self.corrupt_step, self.persist_kill_step,
+            self.torn_manifest_step, self.enospc_step, self.slow_disk_ms,
+            self.wire_drop, self.wire_corrupt,
             self.wire_partition, self.wire_halfclose))
 
 
@@ -142,6 +164,12 @@ def _plan_from_env() -> FaultPlan:
         delay_step=_int_env("HVD_TPU_FAULT_DELAY_STEP"),
         delay_ms=float(os.environ.get("HVD_TPU_FAULT_DELAY_MS", "500")),
         corrupt_step=_int_env("HVD_TPU_FAULT_CORRUPT_STEP"),
+        persist_kill_step=_int_env("HVD_TPU_FAULT_PERSIST_KILL_STEP"),
+        torn_manifest_step=_int_env("HVD_TPU_FAULT_TORN_MANIFEST_STEP"),
+        enospc_step=_int_env("HVD_TPU_FAULT_ENOSPC_STEP"),
+        slow_disk_ms=(
+            None if os.environ.get("HVD_TPU_FAULT_SLOW_DISK_MS") in (None, "")
+            else float(os.environ["HVD_TPU_FAULT_SLOW_DISK_MS"])),
         wire_drop=_wire_env("HVD_TPU_FAULT_WIRE_DROP"),
         wire_corrupt=_wire_env("HVD_TPU_FAULT_WIRE_CORRUPT"),
         wire_partition=_wire_env("HVD_TPU_FAULT_WIRE_PARTITION"),
@@ -229,6 +257,45 @@ def step(step_num: int, rank: int | None = None) -> None:
         os.kill(os.getpid(), p.kill_signal)
         time.sleep(60)  # SIGKILL needs no help; catchable signals get a
         os._exit(128 + p.kill_signal)  # bounded grace, then hard exit
+
+
+def on_checkpoint_persist(path: str, step_num: int,
+                          rank: int | None = None) -> bool:
+    """Persist-path hook, called right before ``_COMMIT`` is written
+    (payload already durable).  Returns True when the injector wrote a
+    (torn) manifest itself and the caller must NOT write the real one.
+
+    Order matters: slow disk delays every commit; ENOSPC raises (the
+    persist thread must surface it without crashing training); a torn
+    manifest hijacks the write; a persist-kill dies in the widest crash
+    window the async split opens — payload durable, no ``_COMMIT``.
+    """
+    p = plan()
+    if _attempt() != p.on_attempt or _rank(rank) != 0:
+        return False
+    if p.slow_disk_ms is not None:
+        time.sleep(p.slow_disk_ms / 1000.0)
+    if p.enospc_step == step_num:
+        import errno
+        raise OSError(errno.ENOSPC, "No space left on device (injected)")
+    if p.torn_manifest_step == step_num:
+        with open(os.path.join(path, "_COMMIT"), "w") as f:
+            f.write('{"step": ')  # half the JSON: mid-write tear
+        sys.stderr.write(
+            f"horovod_tpu.faults: tore _COMMIT of step {step_num} "
+            f"(injected)\n")
+        sys.stderr.flush()
+        return True
+    if p.persist_kill_step == step_num:
+        sys.stderr.write(
+            f"horovod_tpu.faults: killing rank 0 mid-persist of step "
+            f"{step_num} (payload durable, no _COMMIT; injected)\n")
+        sys.stderr.flush()
+        sys.stdout.flush()
+        os.kill(os.getpid(), signal.SIGKILL)
+        time.sleep(60)
+        os._exit(137)
+    return False
 
 
 def on_checkpoint_committed(path: str, step_num: int,

@@ -1,0 +1,198 @@
+"""Host-memory peer replica store for checkpoint snapshots.
+
+Peer replication (docs/fault_tolerance.md "Async & peer-replicated
+checkpointing") keeps a second copy of each rank's newest checkpoint
+snapshot in a *neighbor rank's host memory*: ``put`` pickles the snapshot
+and ships it over the control plane as a SHARD_PUT frame (relayed by the
+coordinator — the plane is a star), ``drain`` pulls received shards out of
+the native inbox into this module, and an elastic restore asks ``best``
+for the newest replica from the *current* membership epoch before it ever
+touches disk.
+
+Why a Python module and not the C++ plane: an elastic reconfiguration
+(elastic.reconfigure) tears down and re-forms the NativeEngine, so nothing
+inside the C++ control plane survives a RECONFIG.  This store is plain
+process-global host memory — it survives the re-form, and
+``bump_epoch`` re-stamps the survivors' entries to the new epoch so they
+stay restorable.  A process that *missed* the reconfiguration keeps its
+old stamps; ``best`` rejects them and the restore falls back to disk —
+exactly the invalidation ISSUE semantics require (a stale replica must
+never win over a committed checkpoint from the new membership).
+
+Epoch flow: the native engine stamps its own epoch into every outbound
+SHARD_PUT (core/src/engine.cc), and the frame layer rejects cross-epoch
+frames on the wire, so every entry that lands here via ``drain`` carries
+the epoch the *plane* had when the snapshot was shipped.
+
+Like faults.py this module is deliberately jax-free: the engine-only
+elastic workers the tests spawn import it without pulling in a device
+runtime.  Snapshots are pickled as-is — numpy trees round-trip bit-exact,
+which is what the restore parity test pins.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+from typing import Any, NamedTuple
+
+from horovod_tpu.core import engine as core_engine
+from horovod_tpu.utils import env
+
+
+class ReplicaEntry(NamedTuple):
+    """One peer's newest snapshot held in local host memory."""
+
+    owner_rank: int
+    step: int
+    epoch: int
+    payload: bytes
+
+
+_lock = threading.Lock()
+# owner_rank -> newest ReplicaEntry received from that owner.  One slot per
+# owner: a replica only exists to serve "newest restorable state", so older
+# shards are dropped on arrival.
+_replicas: dict[int, ReplicaEntry] = {}
+# Newest step the control plane has acknowledged accepting (relay/enqueue
+# succeeded).  Observability only — an ack is NOT end-to-end delivery.
+_last_acked_step: int = -1
+_puts: int = 0
+_drained: int = 0
+
+# Restore-time agreement messages ride the same SHARD_PUT relay as the
+# replicas (the engine-only workers' data plane is identity — the control
+# plane is the only cross-process channel they have).  A view frame is a
+# magic-prefixed payload announcing the sender's best epoch-valid replica
+# step; drain() routes it here instead of the replica store.
+_VIEW_MAGIC = b"\x00hvdview1\x00"
+_views: dict[int, tuple[int, int]] = {}  # owner -> (replica_step, epoch)
+
+
+def enabled() -> bool:
+    return env.ckpt_replicate()
+
+
+def target_rank(rank: int, size: int) -> int:
+    """The neighbor holding this rank's replica: the next rank mod size."""
+    return (rank + 1) % size
+
+
+def put(step: int, state: Any, metadata: dict | None = None,
+        eng: "core_engine.NativeEngine | None" = None) -> bool:
+    """Ship a snapshot to the neighbor's host memory.  Returns True when
+    the control plane accepted the frame (single-rank jobs and a dead
+    plane return False — the disk path still has the data)."""
+    global _puts
+    eng = eng or core_engine.peek_engine()
+    if eng is None or eng.size <= 1:
+        return False
+    payload = pickle.dumps(
+        {"step": int(step), "state": state, "metadata": metadata},
+        protocol=pickle.HIGHEST_PROTOCOL)
+    ok = eng.shard_put(target_rank(eng.rank, eng.size), int(step), payload)
+    if ok:
+        with _lock:
+            _puts += 1
+    return ok
+
+
+def drain(eng: "core_engine.NativeEngine | None" = None) -> int:
+    """Pull every shard waiting in the native inbox into the store (newest
+    step per owner wins) and fold in acks.  Returns shards absorbed."""
+    global _last_acked_step, _drained
+    eng = eng or core_engine.peek_engine()
+    if eng is None:
+        return 0
+    count = 0
+    while True:
+        item = eng.shard_poll()
+        if item is None:
+            break
+        owner, step, epoch, payload = item
+        if payload.startswith(_VIEW_MAGIC):
+            with _lock:
+                _views[owner] = (int(payload[len(_VIEW_MAGIC):]), epoch)
+            continue
+        with _lock:
+            cur = _replicas.get(owner)
+            if cur is None or step >= cur.step:
+                _replicas[owner] = ReplicaEntry(owner, step, epoch, payload)
+            _drained += 1
+        count += 1
+    for _owner, _tgt, step, _epoch in eng.shard_acks():
+        with _lock:
+            _last_acked_step = max(_last_acked_step, step)
+    return count
+
+
+def send_view(replica_step: int,
+              eng: "core_engine.NativeEngine | None" = None) -> None:
+    """Announce this rank's best epoch-valid replica step to every peer.
+
+    Part of the restore agreement (checkpoint._restore_from_peers): after
+    a reconfiguration the survivors' local replica views legitimately
+    differ, and each must learn everyone's before they can pick ONE
+    restore step together.  The step also travels in the payload text —
+    the frame's step field is clamped non-negative for the wire."""
+    eng = eng or core_engine.peek_engine()
+    if eng is None or eng.size <= 1:
+        return
+    payload = _VIEW_MAGIC + str(int(replica_step)).encode()
+    for r in range(eng.size):
+        if r != eng.rank:
+            eng.shard_put(r, max(int(replica_step), 0), payload)
+
+
+def views(epoch: int) -> dict[int, int]:
+    """Per-owner replica-step announcements stamped with *this* epoch
+    (stale-epoch views are invisible, like stale replicas)."""
+    with _lock:
+        return {o: s for o, (s, e) in _views.items() if e == epoch}
+
+
+def best(epoch: int) -> ReplicaEntry | None:
+    """Newest entry stamped with *this* membership epoch, or None.  Stale
+    epochs are rejected — the caller falls back to disk."""
+    with _lock:
+        live = [e for e in _replicas.values() if e.epoch == epoch]
+    return max(live, key=lambda e: e.step) if live else None
+
+
+def decode(entry: ReplicaEntry) -> dict:
+    """Unpickle a replica payload back into {step, state, metadata}."""
+    return pickle.loads(entry.payload)
+
+
+def bump_epoch(new_epoch: int) -> None:
+    """Re-stamp every held entry to the new membership epoch.  Called by
+    elastic.reconfigure on ranks that PARTICIPATED in the reconfiguration:
+    their replicas describe state the new membership agrees on.  Ranks
+    that missed the reconfig never call this, so their stale stamps are
+    rejected by ``best`` and they restore from disk."""
+    with _lock:
+        for owner, e in list(_replicas.items()):
+            _replicas[owner] = e._replace(epoch=int(new_epoch))
+
+
+def clear() -> None:
+    global _last_acked_step, _puts, _drained
+    with _lock:
+        _replicas.clear()
+        _views.clear()
+        _last_acked_step = -1
+        _puts = 0
+        _drained = 0
+
+
+def stats() -> dict:
+    with _lock:
+        return {
+            "replicas": len(_replicas),
+            "owners": sorted(_replicas),
+            "newest_step": max((e.step for e in _replicas.values()),
+                               default=-1),
+            "last_acked_step": _last_acked_step,
+            "puts": _puts,
+            "drained": _drained,
+        }

@@ -360,13 +360,29 @@ def elastic_loop(step_fn, state, *, num_steps: int, manager=None,
 
     from horovod_tpu import checkpoint as _checkpoint
     from horovod_tpu import faults as _faults
+    from horovod_tpu import replication as _replication
     from horovod_tpu.core.engine import MembershipChanged as _Resized
+
+    def _restore_latest(manager, state):
+        # A peer can die DURING the restore agreement (checkpoint
+        # ._restore_from_peers raises MembershipChanged from its wait
+        # loops): reconfigure and retry at the new epoch instead of
+        # letting a cascading failure abort a recoverable job.
+        while True:
+            try:
+                return manager.restore_latest(template=state)
+            except _Resized:
+                from horovod_tpu import elastic as _elastic
+
+                if not _elastic.enabled():
+                    raise
+                _elastic.reconfigure()
 
     start_step = 0
     if manager is not None:
         _checkpoint.install_preemption_handler()
         if resume:
-            ckpt = manager.restore_latest(template=state)
+            ckpt = _restore_latest(manager, state)
             if ckpt is not None:
                 state = ckpt.state
                 start_step = ckpt.step + 1
@@ -389,6 +405,11 @@ def elastic_loop(step_fn, state, *, num_steps: int, manager=None,
     while step < num_steps:
         if manager is not None and _checkpoint.preemption_requested():
             _drain_exit(step - 1, state)
+        if _replication.enabled():
+            # Pump relayed SHARD_PUT frames into the host-memory replica
+            # store every step — a restore after a peer dies can only use
+            # what this rank already drained.
+            _replication.drain()
         _faults.step(step)
         try:
             state = step_fn(step, state)
@@ -406,7 +427,7 @@ def elastic_loop(step_fn, state, *, num_steps: int, manager=None,
             # (the engine's restartable exit is already scheduled).
             _elastic.reconfigure()
             if manager is not None:
-                ckpt = manager.restore_latest(template=state)
+                ckpt = _restore_latest(manager, state)
                 if ckpt is not None:
                     state = ckpt.state
                     step = ckpt.step + 1

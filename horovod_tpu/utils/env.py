@@ -94,7 +94,26 @@ aliases; the TPU-specific defaults differ where the hardware does:
   including the wire-level chaos injectors
   ``HVD_TPU_FAULT_WIRE_{DROP,CORRUPT,PARTITION,HALFCLOSE}`` =
   ``"<rank>[:<frame>][@<epoch>]"`` (the ``@<epoch>`` suffix keys a plan to
-  one membership epoch so an elastic shrink past the fault runs clean).
+  one membership epoch so an elastic shrink past the fault runs clean) and
+  the persist-path injectors ``HVD_TPU_FAULT_PERSIST_KILL_STEP`` (die
+  after the payload is durable but before ``_COMMIT``),
+  ``HVD_TPU_FAULT_TORN_MANIFEST_STEP`` (truncated ``_COMMIT``),
+  ``HVD_TPU_FAULT_ENOSPC_STEP`` (commit raises ``ENOSPC``) and
+  ``HVD_TPU_FAULT_SLOW_DISK_MS`` (added latency per commit).
+* ``HVD_TPU_CKPT_ASYNC`` — async persist (default off): ``save`` only
+  snapshots device state to host at the step barrier; a background persist
+  thread writes the payload and the ``_COMMIT`` manifest, so the train loop
+  stalls for the snapshot only, not the disk write
+  (docs/fault_tolerance.md "Async & peer-replicated checkpointing").
+* ``HVD_TPU_CKPT_REPLICATE`` — peer replication (default off): each save
+  also pushes the pickled snapshot over the control plane (SHARD_PUT
+  frames) to a neighbor rank's host memory; an elastic restore consults
+  the in-memory replica first and touches disk only when no replica from
+  the current membership epoch survives (replication.py).
+* ``HVD_TPU_CKPT_STALENESS_STEPS`` — bounded-staleness assertion window
+  (default 0 = unchecked): tooling and the checkpoint soak fail if the
+  newest complete checkpoint ever lags the training step by more than this
+  many steps.
 """
 
 from __future__ import annotations
@@ -325,6 +344,36 @@ def overlap_buckets_override() -> int | None:
     if not raw:
         return None
     return overlap_buckets()
+
+
+def ckpt_async() -> bool:
+    """``HVD_TPU_CKPT_ASYNC`` — split checkpointing into *snapshot*
+    (device->host at the step barrier) and *persist* (a background thread
+    writes the payload and the ``_COMMIT`` manifest).  Default off: ``save``
+    keeps the synchronous complete-or-invisible semantics PR 3 shipped."""
+    raw = _get("CKPT_ASYNC")
+    return bool(raw) and raw not in ("0", "false", "False")
+
+
+def ckpt_replicate() -> bool:
+    """``HVD_TPU_CKPT_REPLICATE`` — peer-replicate each rank's snapshot to
+    a neighbor rank's host memory over the control plane (SHARD_PUT
+    frames), so an elastic restore can skip disk entirely when a replica
+    from the current membership epoch survives (replication.py)."""
+    raw = _get("CKPT_REPLICATE")
+    return bool(raw) and raw not in ("0", "false", "False")
+
+
+def ckpt_staleness_steps() -> int:
+    """``HVD_TPU_CKPT_STALENESS_STEPS`` — bounded-staleness window for the
+    checkpoint soak and monitoring: the newest complete checkpoint must
+    never lag the training step by more than this many steps.  0 (default)
+    disables the assertion."""
+    raw = _get("CKPT_STALENESS_STEPS")
+    try:
+        return max(0, int(raw)) if raw not in (None, "") else 0
+    except ValueError:
+        return 0
 
 
 def device_headroom_mb() -> float | None:

@@ -38,7 +38,10 @@ def parse_step(name: str) -> int | None:
 
 
 def is_complete(path: str | os.PathLike) -> bool:
-    return os.path.isfile(os.path.join(os.fspath(path), COMMIT_FILE))
+    """True only when the manifest PARSES, not merely exists: a torn
+    ``_COMMIT`` (filesystem tearing the write, injected via
+    ``HVD_TPU_FAULT_TORN_MANIFEST_STEP``) must read as incomplete."""
+    return read_commit(path) is not None
 
 
 def write_commit(path: str | os.PathLike, step: int,

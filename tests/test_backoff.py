@@ -96,10 +96,14 @@ def test_manifest_commit_protocol(tmp_path):
     assert step == 10 and path.endswith("step_10")
     doc = manifest.read_commit(path)
     assert doc["step"] == 10 and doc["metadata"]["rng"] == [1, 2]
-    # Foreign entries are ignored; a garbled manifest reads as None.
+    # Foreign entries are ignored; a garbled manifest reads as None AND
+    # makes the step invisible — a torn _COMMIT (power loss mid-fsync,
+    # HVD_TPU_FAULT_TORN_MANIFEST_STEP) must never win a restore.
     os.makedirs(root / "notes", exist_ok=True)
     with open(os.path.join(manifest.step_dir(root, 2),
                            manifest.COMMIT_FILE), "w") as f:
         f.write("{broken")
     assert manifest.read_commit(manifest.step_dir(root, 2)) is None
-    assert manifest.complete_steps(root) == [2, 10]  # presence, not parse
+    assert manifest.complete_steps(root) == [10]  # parse-validated
+    step, path = manifest.latest_complete(root)
+    assert step == 10

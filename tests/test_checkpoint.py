@@ -232,6 +232,118 @@ def test_manager_torn_dir_is_invisible_and_cleaned(hvd, tmp_path):
     assert not os.path.isdir(os.path.join(mgr.directory, "step_3"))
 
 
+def test_manager_async_save_commits_in_background(hvd, tmp_path,
+                                                  monkeypatch):
+    """HVD_TPU_CKPT_ASYNC=1: save() returns after the snapshot; the
+    persist thread writes _COMMIT and prunes — after drain() the on-disk
+    result is indistinguishable from the synchronous manager's."""
+    monkeypatch.setenv("HVD_TPU_CKPT_ASYNC", "1")
+    mgr = checkpoint.CheckpointManager(tmp_path / "am", max_to_keep=2)
+    for s in (0, 1, 2):
+        mgr.save(s, _mgr_state(float(s)), metadata={"rng": [s]})
+    mgr.drain()
+    assert mgr.steps() == [1, 2]
+    assert mgr.last_committed_step() == 2
+    assert mgr.persist_error() is None
+    ck = mgr.restore_latest(template=_mgr_state(0.0))
+    assert ck.step == 2 and ck.metadata["rng"] == [2]
+    np.testing.assert_array_equal(ck.state["w"], np.full(4, 2.0))
+
+
+def test_manager_torn_manifest_is_invisible(hvd, tmp_path):
+    """HVD_TPU_FAULT_TORN_MANIFEST_STEP: a _COMMIT file that EXISTS but
+    does not parse must read as incomplete (manifest.is_complete parses,
+    never stats) and restore falls back to the previous complete step."""
+    import os
+
+    from horovod_tpu import faults
+    from horovod_tpu.utils import manifest
+
+    faults.install(torn_manifest_step=2)
+    try:
+        mgr = checkpoint.CheckpointManager(tmp_path / "tm", max_to_keep=3)
+        mgr.save(1, _mgr_state(1.0))
+        mgr.save(2, _mgr_state(2.0))  # injector tears this _COMMIT
+    finally:
+        faults.clear()
+    step2 = manifest.step_dir(mgr.directory, 2)
+    assert os.path.isfile(os.path.join(step2, "_COMMIT"))
+    assert not manifest.is_complete(step2)
+    assert mgr.steps() == [1]
+    ck = mgr.restore_latest(template=_mgr_state(0.0))
+    assert ck.step == 1
+    np.testing.assert_array_equal(ck.state["w"], np.full(4, 1.0))
+
+
+def test_manager_enospc_persist_surfaces_without_crashing(hvd, tmp_path,
+                                                          monkeypatch):
+    """HVD_TPU_FAULT_ENOSPC_STEP under the async manager: the persist
+    thread surfaces the failure via persist_error() and the step stays
+    invisible — training is never torn down by checkpoint IO."""
+    import errno
+    import warnings as _warnings
+
+    from horovod_tpu import faults
+
+    monkeypatch.setenv("HVD_TPU_CKPT_ASYNC", "1")
+    faults.install(enospc_step=1)
+    try:
+        mgr = checkpoint.CheckpointManager(tmp_path / "nospc",
+                                           max_to_keep=3)
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("ignore")  # persist-failure warning
+            mgr.save(1, _mgr_state(1.0))  # persist raises ENOSPC
+            mgr.drain()
+            faults.clear()
+            mgr.save(2, _mgr_state(2.0))  # disk "recovered": commits fine
+            mgr.drain()
+    finally:
+        faults.clear()
+    err = mgr.persist_error()
+    assert isinstance(err, OSError) and err.errno == errno.ENOSPC
+    assert mgr.steps() == [2]
+    assert mgr.restore_latest(template=_mgr_state(0.0)).step == 2
+
+
+def test_manager_kill_mid_commit_leaves_step_invisible(hvd, tmp_path):
+    """HVD_TPU_FAULT_PERSIST_KILL_STEP: the process dies after the payload
+    is durable but before _COMMIT exists — the widest crash window the
+    async split opens.  The partial step_<N> directory must be invisible
+    and restore must fall back to the newest complete step."""
+    import os
+    import subprocess
+    import sys
+
+    from horovod_tpu.utils import manifest
+
+    prog = """
+import sys
+import numpy as np
+from horovod_tpu import checkpoint
+mgr = checkpoint.CheckpointManager(sys.argv[1], max_to_keep=3,
+                                   rank=0, size=1)
+mgr.save(1, {"w": np.full(4, 1.0, np.float32)})
+mgr.save(2, {"w": np.full(4, 2.0, np.float32)})  # dies mid-commit
+print("UNREACHABLE", flush=True)
+"""
+    root = str(tmp_path / "kc")
+    proc = subprocess.run(
+        [sys.executable, "-c", prog, root],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "HVD_TPU_FAULT_PERSIST_KILL_STEP": "2"})
+    assert proc.returncode == -9, (proc.returncode, proc.stderr[-2000:])
+    assert "UNREACHABLE" not in proc.stdout
+    step2 = manifest.step_dir(root, 2)
+    assert os.path.isdir(step2)  # payload staged...
+    assert not manifest.is_complete(step2)  # ...but never committed
+    mgr = checkpoint.CheckpointManager(root, rank=0, size=1)
+    assert mgr.steps() == [1]
+    ck = mgr.restore_latest(template={"w": np.zeros(4, np.float32)})
+    assert ck.step == 1
+    np.testing.assert_array_equal(ck.state["w"], np.full(4, 1.0))
+
+
 def test_preemption_flag_roundtrip(hvd):
     checkpoint.clear_preemption()
     assert not checkpoint.preemption_requested()

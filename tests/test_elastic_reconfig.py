@@ -333,7 +333,8 @@ ELASTIC_TRAIN = textwrap.dedent("""
     state = training.elastic_loop(step_fn, state, num_steps=steps,
                                   manager=mgr, checkpoint_every=1)
     print(f"[rank {rank}] FINAL={state['params'].tolist()} pid={pid} "
-          f"now={os.getpid()} size={em.peek_engine().size}", flush=True)
+          f"now={os.getpid()} size={em.peek_engine().size} "
+          f"reads={checkpoint.disk_read_count()}", flush=True)
     em.peek_engine().shutdown()  # coordinated teardown, no EOF-side effects
 """)
 
@@ -410,6 +411,56 @@ def test_elastic_loop_shrinks_and_resumes_bit_exact_from_checkpoint(
     # The job genuinely rewound to the checkpoint: the pre-kill step-3
     # attempt aborted (no completion print), and step 3 completed exactly
     # once, AFTER the reconfiguration notice.
+    assert outs[0].count("STEP 3 rank=0") == 1, outs[0][-2500:]
+    assert outs[0].index("Membership changed") \
+        < outs[0].index("STEP 3 rank=0"), outs[0][-2500:]
+
+
+def test_elastic_loop_peer_restore_zero_disk_reads_bit_exact(tmp_path):
+    """The PR-10 tentpole acceptance scenario: ``HVD_TPU_CKPT_REPLICATE=1``
+    (+ async persist) ships every rank's snapshot to its ring neighbor's
+    host memory as SHARD_PUT frames; when rank 2 dies at step 3 the
+    survivors reconfigure and restore the step-2 state FROM THE REPLICA —
+    ``checkpoint.disk_read_count()`` stays 0 on both survivors — with
+    final parameters bit-identical to the disk-restore run of the exact
+    same scenario (test_elastic_loop_shrinks_and_resumes_bit_exact...).
+    Epoch-stale rejection is pinned at the unit level
+    (tests/test_replication.py): here the reconfigure path re-stamps the
+    survivors' replicas to epoch 1, which is what makes them eligible."""
+    steps = 6
+    expected = str([float(sum(s + 1 for s in range(steps)))] * 4)
+    ckpt = tmp_path / "peer"
+    ckpt.mkdir()
+    port = _free_port()
+    procs = []
+    for r in range(3):
+        env = {**os.environ, "PYTHONPATH": REPO, **FAST_HB,
+               "JAX_PROCESS_ID": str(r),
+               "HVD_TPU_CKPT_REPLICATE": "1",
+               "HVD_TPU_CKPT_ASYNC": "1",
+               "HVD_TPU_FAULT_KILL_RANK": "2",
+               "HVD_TPU_FAULT_KILL_STEP": "3"}
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", ELASTIC_TRAIN, str(r), str(port), "3",
+             str(ckpt), str(steps)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=REPO))
+    outs = _drain(procs, timeout=scaled(240))
+    assert procs[0].returncode == 0, outs[0][-2500:]
+    assert procs[1].returncode == 0, outs[1][-2500:]
+    assert procs[2].returncode != 0  # the killed rank
+    finals = _finals(outs)
+    assert set(finals) == {0, 1}, outs[0][-1500:]
+    # Bit-identical to the uninterrupted (and disk-restore) runs.
+    assert finals[0] == expected, (finals, expected)
+    assert finals[1] == expected
+    for r in (0, 1):
+        line = [ln for ln in outs[r].splitlines() if "FINAL=" in ln][0]
+        # The whole recovery was disk-free: zero payload reads.
+        assert "reads=0" in line, line
+        assert "size=2" in line, line
+    # The job really rewound through the replica: the post-reconfig step 3
+    # completed exactly once, after the membership-change notice.
     assert outs[0].count("STEP 3 rank=0") == 1, outs[0][-2500:]
     assert outs[0].index("Membership changed") \
         < outs[0].index("STEP 3 rank=0"), outs[0][-2500:]
@@ -976,6 +1027,207 @@ def test_concurrent_promotion_and_shutdown_under_tsan():
     assert "RANK2 OK epoch=1 as=1" in outs[2][0], (outs[2][0][-2000:],
                                                    outs[2][1][-3000:])
     for r, (out, err) in enumerate(outs):
+        for chunk in err.split("WARNING: ThreadSanitizer")[1:]:
+            assert "hvdcore" not in chunk.split("=" * 18)[0], (
+                f"tsan race in libhvdcore on rank {r}:\n{chunk[:4000]}")
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint chaos soak: the persist-path injectors (torn manifest, ENOSPC,
+# slow disk) and the two kill drills, each driven through the REAL
+# training.elastic_loop with async persist + peer replication + the
+# bounded-staleness backpressure knob all on at once.
+
+
+CKPT_SOAK_TRAIN = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    from horovod_tpu.core.engine import NativeEngine, OP_ALLREDUCE
+    from horovod_tpu.core import engine as em
+    from horovod_tpu.core.executors import local_executor
+    from horovod_tpu import checkpoint, elastic, training
+    from horovod_tpu.utils import manifest
+
+    rank, port, n = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    ckpt_dir, steps = sys.argv[4], int(sys.argv[5])
+    eng = NativeEngine(rank, n, executor=local_executor,
+                       coordinator_host="127.0.0.1", coordinator_port=port,
+                       cycle_time_ms=2.0)
+    elastic.attach(eng)
+    mgr = checkpoint.CheckpointManager(ckpt_dir, max_to_keep=2, rank=rank,
+                                       size=1)
+
+    @elastic.on_reconfigure
+    def _regate(ev):
+        # The disk-writer seat follows ENGINE rank 0 across failovers:
+        # after a coordinator death the promoted standby must take over
+        # persist duty or the job silently stops checkpointing.
+        mgr._rank_override = ev.new_rank
+
+    def step_fn(step, state):
+        e = em.peek_engine()
+        h = e.enqueue(f"soak.g{step}",
+                      np.full(4, float(step + 1), np.float32), OP_ALLREDUCE)
+        g = e.synchronize(h, timeout_s=120.0)
+        return {"params": state["params"] + g}
+
+    state = {"params": np.zeros(4, np.float32)}
+    state = training.elastic_loop(step_fn, state, num_steps=steps,
+                                  manager=mgr, checkpoint_every=1)
+    err = mgr.persist_error()
+    complete = manifest.complete_steps(ckpt_dir)
+    print(f"[rank {rank}] SOAK FINAL={state['params'].tolist()} "
+          f"newest={max(complete) if complete else -1} "
+          f"size={em.peek_engine().size} "
+          f"perr={type(err).__name__ if err else 'None'}", flush=True)
+    em.peek_engine().shutdown()
+""")
+
+
+_SOAK_MODES = [
+    ("torn-manifest", {"HVD_TPU_FAULT_TORN_MANIFEST_STEP": "2"}),
+    ("enospc", {"HVD_TPU_FAULT_ENOSPC_STEP": "2"}),
+    ("slow-disk", {"HVD_TPU_FAULT_SLOW_DISK_MS": "200"}),
+    ("kill-worker", {"HVD_TPU_FAULT_KILL_RANK": "2",
+                     "HVD_TPU_FAULT_KILL_STEP": "3"}),
+    ("kill-coordinator", {"HVD_TPU_FAULT_KILL_RANK": "0",
+                          "HVD_TPU_FAULT_KILL_STEP": "3"}),
+]
+
+
+@pytest.mark.slow
+def test_checkpoint_chaos_soak_bounded_staleness_never_hangs(tmp_path):
+    """The persist path under fire (HVD_TPU_SOAK_REPS rounds of torn
+    manifest / ENOSPC / slow disk / worker kill / coordinator kill), all
+    with async persist + peer replication + HVD_TPU_CKPT_STALENESS_STEPS
+    backpressure on.  Three invariants, per ISSUE acceptance:
+
+    * never hangs — _drain's timeout kills and fails the round;
+    * survivors always finish rc=0 with the bit-exact uninterrupted
+      final state (kill rounds rewind through the replica and replay);
+    * the newest COMPLETE checkpoint is never more than the staleness
+      bound behind the last trained step — a torn or ENOSPC'd commit
+      leaves that one step invisible, it never poisons the ones after.
+    """
+    reps = int(os.environ.get("HVD_TPU_SOAK_REPS", "1"))
+    steps, bound = 6, 2
+    expected = str([float(sum(s + 1 for s in range(steps)))] * 4)
+    for rep in range(reps):
+        for name, fault in _SOAK_MODES:
+            ckpt = tmp_path / f"{name}-{rep}"
+            ckpt.mkdir()
+            port = _free_port()
+            procs = []
+            for r in range(3):
+                env = {**os.environ, "PYTHONPATH": REPO, **FAST_HB,
+                       "JAX_PROCESS_ID": str(r),
+                       "HVD_TPU_CKPT_REPLICATE": "1",
+                       "HVD_TPU_CKPT_ASYNC": "1",
+                       "HVD_TPU_CKPT_STALENESS_STEPS": str(bound),
+                       **fault}
+                procs.append(subprocess.Popen(
+                    [sys.executable, "-c", CKPT_SOAK_TRAIN, str(r),
+                     str(port), "3", str(ckpt), str(steps)],
+                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                    text=True, env=env, cwd=REPO))
+            outs = _drain(procs, timeout=scaled(240))
+            killed = int(fault.get("HVD_TPU_FAULT_KILL_RANK", "-1"))
+            for r in range(3):
+                ctx = (name, rep, r, outs[r][-2500:])
+                if r == killed:
+                    assert procs[r].returncode != 0, ctx
+                    continue
+                assert procs[r].returncode == 0, ctx
+                line = [ln for ln in outs[r].splitlines()
+                        if "SOAK FINAL=" in ln][0]
+                assert f"FINAL={expected}" in line, ctx
+                newest = int(line.split("newest=")[1].split()[0])
+                assert newest >= steps - 1 - bound, ctx
+
+
+# ---------------------------------------------------------------------------
+# Peer-replication concurrency under ThreadSanitizer: a dedicated thread
+# hammers the SHARD_PUT path while the main thread runs collectives and
+# drains the shard inbox — the exact contention the async persist thread
+# creates in production.
+
+
+TSAN_SHARD = textwrap.dedent("""
+    import sys, threading
+    import numpy as np
+    from horovod_tpu.core.engine import NativeEngine, OP_ALLREDUCE
+    from horovod_tpu.core.executors import local_executor
+    from horovod_tpu import replication
+
+    rank, port, n = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    eng = NativeEngine(rank, n, executor=local_executor,
+                       coordinator_host="127.0.0.1", coordinator_port=port,
+                       cycle_time_ms=2.0)
+    stop = threading.Event()
+
+    def putter():
+        step = 0
+        while not stop.is_set() and step < 400:
+            replication.put(step, {"w": np.full(64, float(step),
+                                                np.float32)}, {}, eng=eng)
+            step += 1
+
+    t = threading.Thread(target=putter, daemon=True)
+    t.start()
+    for i in range(40):
+        h = eng.enqueue(f"ts.{i}", np.ones(32, np.float32), OP_ALLREDUCE)
+        eng.synchronize(h, timeout_s=120.0)
+        replication.drain(eng)
+    stop.set()
+    t.join()
+    replication.drain(eng)
+    s = replication.stats()
+    assert s["puts"] > 0 and s["drained"] > 0, s
+    print(f"RANK{rank} SHARD OK puts={s['puts']} "
+          f"drained={s['drained']}", flush=True)
+    eng.shutdown()
+""")
+
+
+@pytest.mark.tsan
+@pytest.mark.slow
+def test_shard_replication_concurrency_under_tsan():
+    """SHARD_PUT/SHARD_ACK under ThreadSanitizer: the replication putter
+    thread races the collective cycle thread and the drain loop on the
+    native shard inbox.  No data-race report may implicate libhvdcore."""
+    core = os.path.join(REPO, "horovod_tpu", "core")
+    rc = subprocess.run(["make", "-C", core, "tsan", "-j4"],
+                        capture_output=True)
+    if rc.returncode != 0 and not os.path.exists(
+            os.path.join(core, "libhvdcore_tsan.so")):
+        pytest.skip("tsan build unavailable")
+    runtime = tsan_runtime()
+    if runtime is None:
+        pytest.skip("libtsan runtime not installed")
+    port = _free_port()
+    env = {**os.environ, "PYTHONPATH": REPO, **FAST_HB,
+           "HVD_TPU_HEARTBEAT_TIMEOUT_MS": str(int(scaled(8000))),
+           "HVD_TPU_ABORT_GRACE_MS": "5000",
+           "HVD_CORE_LIB": "libhvdcore_tsan.so",
+           "LD_PRELOAD": runtime,
+           "TSAN_OPTIONS": "report_bugs=1 halt_on_error=0 exitcode=0"}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", TSAN_SHARD, str(r), str(port), "2"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=REPO)
+        for r in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            outs.append(p.communicate(timeout=scaled(300)))
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+    for r, (out, err) in enumerate(outs):
+        assert f"RANK{r} SHARD OK" in out, (out[-2000:], err[-3000:])
         for chunk in err.split("WARNING: ThreadSanitizer")[1:]:
             assert "hvdcore" not in chunk.split("=" * 18)[0], (
                 f"tsan race in libhvdcore on rank {r}:\n{chunk[:4000]}")

@@ -237,7 +237,9 @@ def test_truncated_frame_structured_error():
             assert time.monotonic() < deadline, "coordinator never listened"
             time.sleep(0.1)
     try:
-        peer.sendall(frame(1, struct.pack("<i", 1)))       # HELLO rank 1
+        # HELLO rank 1, standby port 0 (the failover PR widened the HELLO
+        # to {i32 rank, i32 standby_listen_port}; payload_len must be 8).
+        peer.sendall(frame(1, struct.pack("<ii", 1, 0)))
         ack = peer.recv(16)
         assert len(ack) == 16 and ack[:4] == b"FDVH", ack  # HELLO_ACK
         # REQUEST header promising 64 payload bytes, deliver 8, die.

@@ -1,0 +1,185 @@
+"""Peer-replicated checkpoint store (horovod_tpu/replication.py) and the
+CheckpointManager peer-restore path (docs/fault_tolerance.md "Async &
+peer-replicated checkpointing").
+
+The store tests use a duck-typed engine (the NativeEngine shard API is
+three methods plus rank/size/epoch) so the epoch-invalidation semantics
+are pinned without a control plane; the manager tests monkeypatch
+``peek_engine`` the same way and assert the acceptance bar directly:
+peer restore performs ZERO payload reads from disk
+(``checkpoint.disk_read_count``), round-trips bit-exact, and an
+epoch-stale replica is rejected with a clean disk fallback.  End-to-end
+frames over a real control plane are covered by the elastic rejoin test
+in tests/test_elastic_reconfig.py and the shard soak in
+tests/test_failure_detection.py.
+"""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from horovod_tpu import checkpoint, replication
+
+
+class FakeEngine:
+    """NativeEngine shard-API duck type: shard_put stamps this engine's
+    epoch (exactly what core/src/engine.cc ShardPutSend does) and loops
+    the frame into ``inbox`` so drain() on the same object plays the
+    RECEIVING rank."""
+
+    def __init__(self, rank=0, size=2, epoch=0):
+        self.rank, self.size, self.epoch = rank, size, epoch
+        self.sent = []
+        self.inbox = []
+        self.acks = []
+
+    def shard_put(self, target_rank, step, payload):
+        self.sent.append((target_rank, step, bytes(payload)))
+        self.inbox.append((self.rank, step, self.epoch, bytes(payload)))
+        self.acks.append((self.rank, target_rank, step, self.epoch))
+        return True
+
+    def shard_poll(self):
+        return self.inbox.pop(0) if self.inbox else None
+
+    def shard_acks(self):
+        out, self.acks = self.acks, []
+        return out
+
+
+@pytest.fixture(autouse=True)
+def _clean_store():
+    replication.clear()
+    yield
+    replication.clear()
+
+
+def _entry(owner, step, epoch, state):
+    payload = pickle.dumps({"step": step, "state": state, "metadata": {}})
+    return replication.ReplicaEntry(owner, step, epoch, payload)
+
+
+def test_target_rank_is_ring_neighbor():
+    assert replication.target_rank(0, 4) == 1
+    assert replication.target_rank(3, 4) == 0
+    assert replication.target_rank(0, 1) == 0
+
+
+def test_put_ships_to_neighbor_and_drain_absorbs():
+    eng = FakeEngine(rank=1, size=3, epoch=0)
+    state = {"w": np.arange(4.0)}
+    assert replication.put(7, state, {"rng": [1, 2]}, eng=eng)
+    assert eng.sent[0][0] == 2  # ring neighbor of rank 1
+    assert replication.drain(eng) == 1
+    entry = replication.best(epoch=0)
+    assert entry is not None and entry.step == 7 and entry.owner_rank == 1
+    doc = replication.decode(entry)
+    np.testing.assert_array_equal(doc["state"]["w"], np.arange(4.0))
+    assert doc["metadata"] == {"rng": [1, 2]}
+    assert replication.stats()["last_acked_step"] == 7
+
+
+def test_put_refuses_single_rank_jobs():
+    assert not replication.put(1, {"w": 0}, eng=FakeEngine(rank=0, size=1))
+    assert replication.best(epoch=0) is None
+
+
+def test_newest_step_per_owner_wins():
+    eng = FakeEngine(rank=0, size=2)
+    for s in (3, 9, 5):  # out-of-order arrival: 9 must survive
+        replication.put(s, {"s": s}, eng=eng)
+    replication.drain(eng)
+    assert replication.best(epoch=0).step == 9
+    assert replication.stats()["replicas"] == 1  # one slot per owner
+
+
+def test_best_rejects_stale_epoch_and_bump_revalidates():
+    eng = FakeEngine(rank=0, size=2, epoch=0)
+    replication.put(4, {"s": 4}, eng=eng)
+    replication.drain(eng)
+    # The membership moved on without this entry being re-stamped: a
+    # restore at epoch 1 must NOT see the epoch-0 replica.
+    assert replication.best(epoch=1) is None
+    assert replication.best(epoch=0) is not None
+    # A rank that PARTICIPATED in the reconfig re-stamps its survivors.
+    replication.bump_epoch(1)
+    assert replication.best(epoch=1).step == 4
+    assert replication.best(epoch=0) is None
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager._restore_from_peers — the acceptance-bar unit tests
+# ---------------------------------------------------------------------------
+
+def _np_state(v: float):
+    return {"w": np.full(4, v, np.float32), "step_arr": np.array(int(v))}
+
+
+def _seed_replica(owner, step, epoch, state):
+    with replication._lock:
+        replication._replicas[owner] = _entry(owner, step, epoch, state)
+
+
+def test_manager_peer_restore_zero_disk_reads(tmp_path, monkeypatch):
+    """A replica at least as new as disk restores with ZERO payload reads
+    from disk, bit-exact against what was replicated."""
+    from horovod_tpu.core import engine as core_engine
+
+    monkeypatch.setenv("HVD_TPU_CKPT_REPLICATE", "1")
+    monkeypatch.setattr(core_engine, "peek_engine",
+                        lambda: FakeEngine(rank=1, size=3, epoch=2))
+    _seed_replica(owner=2, step=5, epoch=2, state=_np_state(5.0))
+    mgr = checkpoint.CheckpointManager(tmp_path / "peer", rank=1, size=1)
+    checkpoint.reset_disk_read_count()
+    ck = mgr.restore_latest(template=_np_state(0.0), broadcast=False)
+    assert ck is not None and ck.step == 5
+    np.testing.assert_array_equal(ck.state["w"], np.full(4, 5.0, np.float32))
+    assert checkpoint.disk_read_count() == 0
+
+
+def test_manager_peer_restore_stale_epoch_falls_back_to_disk(tmp_path,
+                                                             monkeypatch):
+    """An epoch-stale replica (newer step!) must lose to the committed
+    disk checkpoint from the current membership."""
+    from horovod_tpu.core import engine as core_engine
+
+    monkeypatch.setenv("HVD_TPU_CKPT_REPLICATE", "1")
+    monkeypatch.setattr(core_engine, "peek_engine",
+                        lambda: FakeEngine(rank=0, size=2, epoch=3))
+    mgr = checkpoint.CheckpointManager(tmp_path / "stale", rank=0, size=1)
+    mgr.save(2, _np_state(2.0))
+    _seed_replica(owner=1, step=9, epoch=1, state=_np_state(9.0))  # stale
+    checkpoint.reset_disk_read_count()
+    ck = mgr.restore_latest(template=_np_state(0.0), broadcast=False)
+    assert ck is not None and ck.step == 2  # disk won
+    np.testing.assert_array_equal(ck.state["w"], np.full(4, 2.0, np.float32))
+    assert checkpoint.disk_read_count() > 0  # it really came from disk
+
+
+def test_manager_peer_restore_prefers_newer_disk(tmp_path, monkeypatch):
+    """Disk strictly newer than the (epoch-valid) replica wins — a replica
+    must never roll training back past a committed checkpoint."""
+    from horovod_tpu.core import engine as core_engine
+
+    monkeypatch.setenv("HVD_TPU_CKPT_REPLICATE", "1")
+    monkeypatch.setattr(core_engine, "peek_engine",
+                        lambda: FakeEngine(rank=0, size=2, epoch=0))
+    mgr = checkpoint.CheckpointManager(tmp_path / "newer", rank=0, size=1)
+    mgr.save(8, _np_state(8.0))
+    _seed_replica(owner=1, step=4, epoch=0, state=_np_state(4.0))
+    ck = mgr.restore_latest(template=_np_state(0.0), broadcast=False)
+    assert ck is not None and ck.step == 8
+
+
+def test_manager_peer_restore_disabled_without_knob(tmp_path, monkeypatch):
+    from horovod_tpu.core import engine as core_engine
+
+    monkeypatch.delenv("HVD_TPU_CKPT_REPLICATE", raising=False)
+    monkeypatch.delenv("HOROVOD_CKPT_REPLICATE", raising=False)
+    monkeypatch.setattr(core_engine, "peek_engine",
+                        lambda: FakeEngine(rank=0, size=2, epoch=0))
+    _seed_replica(owner=1, step=9, epoch=0, state=_np_state(9.0))
+    mgr = checkpoint.CheckpointManager(tmp_path / "off", rank=0, size=1)
+    assert mgr.restore_latest(template=_np_state(0.0), broadcast=False) \
+        is None
