@@ -55,8 +55,25 @@ Two phases, one JSON metric line each:
    the acceptance bar is stall < one step time at that config
    (docs/benchmarks.md).
 
+2d. **Replication data-plane bench** — engine-only multi-process jobs (2
+   then 4 ranks) replicate ``BENCH_DP_BYTES`` of state per step over the
+   rank-to-rank bulk data plane (dataplane.py, ZeRO-sharded
+   replication.py) and report what ONE rank ships per snapshot::
+
+       {"metric": "dataplane_replication_bytes_per_rank", "value": N,
+        "unit": "bytes", "vs_baseline": <whole_replica_bytes / value>,
+        "bytes_per_rank_n2": M, "relay_bytes": 0,
+        "bandwidth_mb_s": B}
+
+   ``vs_baseline`` is the reduction over the pre-shard design, which
+   shipped the WHOLE encoded snapshot per rank (so ~N at N ranks); the
+   harness asserts the ~1/N scaling from 2 -> 4 ranks and that steady
+   state moved ZERO payload bytes through the coordinator star
+   (``replication_stats()["bytes_shipped_relay"] == 0`` on every rank).
+
 ``BENCH_SKIP_EAGER=1`` / ``BENCH_SKIP_RESNET=1`` / ``BENCH_SKIP_PLAN=1``
-/ ``BENCH_SKIP_CKPT=1`` skip individual phases.
+/ ``BENCH_SKIP_CKPT=1`` / ``BENCH_SKIP_DATAPLANE=1`` skip individual
+phases.
 
 3. **Fault-detection MTTR** (``bench.py --fault``) — two-process engine
    job; rank 1 is SIGKILLed at steady state and the survivor's
@@ -443,6 +460,100 @@ def checkpoint_bench() -> None:
     }))
 
 
+DATAPLANE_WORKER = textwrap.dedent("""
+    import os, sys, time
+    import numpy as np
+    from horovod_tpu import dataplane, replication
+    from horovod_tpu.core import engine as ce
+    from horovod_tpu.core.engine import NativeEngine
+    from horovod_tpu.core.executors import local_executor
+
+    rank, port, n = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    nbytes = int(os.environ.get("BENCH_DP_BYTES", str(8 << 20)))
+    steps = int(os.environ.get("BENCH_DP_STEPS", "3"))
+    bp = dataplane.ensure_listener()
+    eng = NativeEngine(rank, n, executor=local_executor,
+                       coordinator_host="127.0.0.1", coordinator_port=port,
+                       cycle_time_ms=2.0, bulk_port=bp)
+    ce.replace_engine(None, eng)
+    state = {"w": np.zeros(max(1, nbytes // 4), np.float32)}
+    blob_len = len(replication.encode_snapshot(0, state))
+    for step in range(1, steps + 1):
+        replication.put(step, state, eng=eng)
+    # Steady state: this rank holds its OWN shard of the newest step plus
+    # its ring predecessor's (2 holders per shard; full reassembly at
+    # N > 2 is the restore path's transfer plan, not steady state).
+    want = {rank, (rank - 1) % n}
+    deadline = time.time() + 60
+    done = False
+    while time.time() < deadline:
+        replication.drain(eng)
+        done = want <= set(replication.have_shards(steps, eng.epoch))
+        if done:
+            break
+        time.sleep(0.02)
+    s = replication.replication_stats()
+    s["blob_len"] = blob_len
+    s["replicated"] = done
+    print(f"RANK{rank} STATS={s!r}", flush=True)
+    time.sleep(0.5)
+    eng.shutdown()
+""")
+
+
+def dataplane_bench() -> None:
+    """Per-rank replication traffic of the ZeRO-sharded bulk data plane.
+
+    Two engine-only jobs (N=2, N=4) replicate the same state; each rank
+    ships exactly its own 1/N shard per snapshot, rank-to-rank.  Asserted
+    here, not just reported: bytes per rank halve from N=2 to N=4, and
+    the coordinator relayed ZERO payload bytes in steady state."""
+    def port() -> int:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    def run(n: int) -> list[dict]:
+        cp = port()
+        env = {**os.environ, "PYTHONPATH": os.path.dirname(
+            os.path.abspath(__file__))}
+        procs = [subprocess.Popen(
+            [sys.executable, "-c", DATAPLANE_WORKER, str(r), str(cp), str(n)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env)
+            for r in range(n)]
+        stats = []
+        for p in procs:
+            out, _ = p.communicate(timeout=180)
+            assert p.returncode == 0, out[-2000:]
+            line = next(ln for ln in out.splitlines() if "STATS=" in ln)
+            stats.append(eval(line.split("STATS=", 1)[1]))
+        return stats
+
+    steps = int(os.environ.get("BENCH_DP_STEPS", "3"))
+    s2, s4 = run(2), run(4)
+    for stats in (s2, s4):
+        for s in stats:
+            assert s["replicated"], s
+            assert s["bytes_shipped_relay"] == 0, s  # zero coordinator bytes
+    per_rank2 = max(s["bytes_shipped_direct"] for s in s2) / steps
+    per_rank4 = max(s["bytes_shipped_direct"] for s in s4) / steps
+    assert 0.35 <= per_rank4 / per_rank2 <= 0.65, (per_rank2, per_rank4)
+    whole = s4[0]["blob_len"]  # what the pre-shard design shipped per rank
+    bw = max(s["bandwidth_bytes_per_s"] for s in s4)
+    print(json.dumps({
+        "metric": "dataplane_replication_bytes_per_rank",
+        "value": int(per_rank4),
+        "unit": "bytes",
+        "vs_baseline": round(whole / max(per_rank4, 1), 2),
+        "bytes_per_rank_n2": int(per_rank2),
+        "relay_bytes": 0,
+        "bandwidth_mb_s": round(bw / 1e6, 1),
+    }))
+
+
 def overlap_plan_microbench() -> None:
     """Width-1 planner check, in the harness where the regression lived:
     lower a small training step over a ONE-device mesh and assert the
@@ -492,6 +603,8 @@ def main() -> None:
         overlap_plan_microbench()
     if os.environ.get("BENCH_SKIP_CKPT") != "1":
         checkpoint_bench()
+    if os.environ.get("BENCH_SKIP_DATAPLANE") != "1":
+        dataplane_bench()
     if os.environ.get("BENCH_SKIP_RESNET") == "1":
         return
     import jax

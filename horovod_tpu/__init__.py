@@ -44,6 +44,7 @@ for _mod, _names in {
         "stall_report", "subset_active",
     ),
     "horovod_tpu.analysis.schedule": ("divergence_report",),
+    "horovod_tpu.replication": ("replication_stats",),
     "horovod_tpu.core.engine": ("CollectiveError", "MembershipChanged"),
     "horovod_tpu.elastic": ("coordinator_endpoint", "on_reconfigure",
                             "resize_event"),
@@ -78,9 +79,10 @@ del _mod, _names, _n
 _MODULE_ATTRS = {"profiling": "horovod_tpu.utils.profiling"}
 
 _SUBMODULES = frozenset({
-    "basics", "callbacks", "checkpoint", "core", "data", "elastic",
-    "faults", "flax", "keras", "mesh", "models", "ops", "parallel", "run",
-    "tensorflow", "torch", "training", "utils",
+    "basics", "callbacks", "checkpoint", "core", "data", "dataplane",
+    "elastic", "faults", "flax", "keras", "mesh", "models", "ops",
+    "parallel", "replication", "run", "tensorflow", "torch", "training",
+    "utils",
 })
 
 # NOTE: __all__ deliberately excludes the lazy submodules — a star-import

@@ -819,15 +819,15 @@ class CheckpointManager:
 
     def _restore_from_peers(self, *,
                             broadcast: bool = True) -> ElasticCheckpoint | None:
-        """Disk-free restore from a peer-replicated host-memory snapshot.
+        """Disk-free restore from ZeRO-sharded peer-replicated host memory.
 
-        Replicas are keyed by the membership epoch the control plane
-        stamped into their SHARD_PUT frames; only replicas from the
-        engine's CURRENT epoch are eligible (a RECONFIG re-stamps
-        survivors via ``replication.bump_epoch``, so anything a departed
-        rank pushed under the old epoch is rejected here).  The replica
-        must also be at least as new as the newest complete step on
-        disk — otherwise disk wins and this returns None."""
+        Shards are keyed by the membership epoch the control plane stamped
+        into their frames; only shards from the engine's CURRENT epoch are
+        eligible (a RECONFIG re-stamps survivors via
+        ``replication.bump_epoch``, so anything a departed rank pushed
+        under the old epoch is invisible to the election here).  The
+        elected step must also be at least as new as the newest complete
+        step on disk — otherwise disk wins and this returns None."""
         if not replication.enabled():
             return None
         from horovod_tpu.core import engine as _core_engine
@@ -835,77 +835,71 @@ class CheckpointManager:
         if eng is None:
             return None
         replication.drain(eng)
-        entry = replication.best(eng.epoch)
-        local = entry.step if entry is not None else -1
         # Coordination is keyed on the ENGINE job, not the manager's
         # rank/size overrides: elastic workers run one manager per process
         # (size_override=1, only rank 0 writes disk) yet must still agree
         # on ONE restore step — with async persist the survivors' local
-        # views (replica inbox, commit lag) legitimately differ, and
+        # views (shard inbox, commit lag) legitimately differ, and
         # picking independently desynchronizes the replayed collectives.
         coordinated = broadcast and eng.size > 1
         if not coordinated:
-            # Engine-only elastic worker (size=1 manager): weigh the
-            # local replica against the local filesystem view only.
-            if entry is None:
+            # Engine-only elastic worker (size=1 manager): restore from
+            # whatever shard sets completed LOCALLY (at N=2 every rank
+            # holds both byte ranges), weighed against the local
+            # filesystem view only.
+            doc = replication.restore_local(eng.epoch)
+            if doc is None:
                 return None
             self.drain()
             disk = self.latest_step()
-            if disk is not None and int(disk) > entry.step:
+            if disk is not None and int(disk) > int(doc["step"]):
                 return None
-            doc = replication.decode(entry)
             return ElasticCheckpoint(int(doc["step"]), doc["state"],
                                      doc.get("metadata") or {})
-        # Multi-rank agreement.  The engine-only elastic workers have NO
-        # cross-process data plane (their executor is identity; enqueue()
-        # only negotiates), so the agreement rides the same control-plane
-        # SHARD relay the replicas travelled on: every rank announces its
-        # best epoch-valid replica step as a view frame, each rank reaches
-        # the SAME decision from the same exchanged views, and the newest
-        # holder ships the winning snapshot to ranks that lack it.  Without
-        # this agreement the survivors pick restore points independently —
-        # with async persist their local views (replica inbox, commit lag)
-        # legitimately differ, and divergent resume steps desynchronize
-        # the replayed collectives.
+        # Multi-rank agreement, extended from single best-step views to
+        # shard SETS: every rank broadcasts an inventory of the shards it
+        # holds (step, cut, indices) over the control-plane relay, each
+        # rank computes the SAME election from the same exchanged
+        # inventories — the newest step whose shard set is COMPLETE across
+        # the union — and the lowest-rank holder of each shard streams it
+        # to the ranks that lack it over the bulk data plane (falling to
+        # the coordinator relay per shard).  An incomplete or torn set is
+        # never restored: the election skips it, or the assemble wait
+        # below times out and the job falls back to disk.
         #
         # Every rank drains its OWN manager before announcing (a no-op off
-        # the disk writer): once all views are in, every writer's commits
-        # have landed and the shared-directory view below is stable.
+        # the disk writer): once all inventories are in, every writer's
+        # commits have landed and the shared-directory view below is
+        # stable.
         self.drain()
-        replication.send_view(local, eng)
+        replication.send_inventory(eng)
         deadline = time.monotonic() + _PEER_RESTORE_TIMEOUT_S
         while True:
             replication.drain(eng)
-            views = replication.views(eng.epoch)
-            if len(views) >= eng.size - 1:
+            invs = replication.inventories(eng.epoch)
+            if len(invs) >= eng.size:  # peers + this rank's pinned view
                 break
-            self._check_restore_liveness(eng, deadline, "peer views")
+            self._check_restore_liveness(eng, deadline, "peer inventories")
             time.sleep(0.01)
-        steps = [int(local) if r == eng.rank else int(views.get(r, -1))
-                 for r in range(eng.size)]
-        best_step = max(steps)
+        election = replication.elect(invs)
         disk = self.latest_step()
         disk = -1 if disk is None else int(disk)
-        if best_step < 0 or disk > best_step:
-            # No epoch-valid replica anywhere, or disk is strictly newer:
-            # every rank computes this from the same views and the same
-            # (now stable) directory, so all take the disk path together.
+        if election is None or disk > election["step"]:
+            # No complete epoch-valid shard set anywhere, or disk is
+            # strictly newer: every rank computes this from the same
+            # inventories and the same (now stable) directory, so all
+            # take the disk path together.
+            replication.note_disk_restore()
             return None
-        holder = steps.index(best_step)
-        if eng.rank == holder:
-            for r in range(eng.size):
-                if r != eng.rank and steps[r] < best_step:
-                    eng.shard_put(r, best_step, entry.payload)
-        if entry is None or entry.step < best_step:
-            while True:
-                replication.drain(eng)
-                entry = replication.best(eng.epoch)
-                if entry is not None and entry.step >= best_step:
-                    break
-                self._check_restore_liveness(eng, deadline,
-                                             "replica payload")
-                time.sleep(0.01)
-        doc = replication.decode(entry)
+        replication.ship_missing(election, eng)
+        while True:
+            replication.drain(eng)
+            blob = replication.assemble(election, eng.epoch)
+            if blob is not None:
+                break
+            self._check_restore_liveness(eng, deadline, "replica shards")
+            time.sleep(0.01)
+        doc = replication.decode_snapshot(blob)
         return ElasticCheckpoint(int(doc["step"]), doc["state"],
                                  doc.get("metadata") or {})
 

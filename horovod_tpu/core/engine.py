@@ -108,7 +108,7 @@ def _load_library() -> ctypes.CDLL:
         ctypes.c_longlong,
         ctypes.c_double, ctypes.c_int, ctypes.c_double, ctypes.c_int,
         ctypes.c_int, ctypes.c_int, ctypes.c_longlong,
-        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
     lib.hvd_start.restype = ctypes.c_int
     lib.hvd_start.argtypes = [ctypes.c_void_p,
                               ctypes.POINTER(ctypes.c_int),
@@ -161,6 +161,13 @@ def _load_library() -> ctypes.CDLL:
     lib.hvd_shard_ack_poll.restype = ctypes.c_int
     lib.hvd_shard_ack_poll.argtypes = [ctypes.c_void_p,
                                        ctypes.POINTER(ctypes.c_longlong)]
+    lib.hvd_ticket_request.restype = ctypes.c_int
+    lib.hvd_ticket_request.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                       ctypes.c_longlong, ctypes.c_longlong,
+                                       ctypes.c_char_p]
+    lib.hvd_ticket_poll.restype = ctypes.c_int
+    lib.hvd_ticket_poll.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_int]
     lib.hvd_coord_state.restype = ctypes.c_int
     lib.hvd_coord_state.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                     ctypes.c_int]
@@ -256,19 +263,24 @@ class NativeEngine:
                  coordinator_port: int = 0,
                  cycle_time_ms: float | None = None,
                  cache_capacity: int | None = None,
-                 epoch: int = 0):
+                 epoch: int = 0,
+                 bulk_port: int = 0):
         self.rank = rank
         self.size = size
         self.epoch = epoch
+        self.bulk_port = bulk_port
         # Remembered so an elastic reconfiguration (elastic.py) can re-form
         # the engine in this same process with the same wiring choices —
         # executor is kept UN-resolved so the local/multihost default is
-        # re-derived for the new size.
+        # re-derived for the new size.  bulk_port rides along because the
+        # data-plane listener (dataplane.py) is process-global and survives
+        # the reconfiguration; the new HELLO re-advertises the same port.
         self._ctor = dict(executor=executor,
                           coordinator_host=coordinator_host,
                           coordinator_port=coordinator_port,
                           cycle_time_ms=cycle_time_ms,
-                          cache_capacity=cache_capacity)
+                          cache_capacity=cache_capacity,
+                          bulk_port=bulk_port)
         self._lib = lib()
         self._store: dict[str, np.ndarray] = {}
         self._results: dict[int, np.ndarray] = {}
@@ -301,7 +313,8 @@ class NativeEngine:
             epoch,
             tl.encode() if self._timeline_enabled else None,
             (coordinator_host or "127.0.0.1").encode(),
-            coordinator_port)
+            coordinator_port,
+            bulk_port)
         err = ctypes.create_string_buffer(512)
         port = ctypes.c_int(0)
         rc = self._lib.hvd_start(self._ptr, ctypes.byref(port), err, 512)
@@ -590,6 +603,50 @@ class NativeEngine:
             out.append((int(ack[0]), int(ack[1]), int(ack[2]), int(ack[3])))
         return out
 
+    # -- bulk data plane (docs/fault_tolerance.md "Bulk data plane") --------
+
+    def ticket_request(self, dst_rank: int, step: int, nbytes: int,
+                       manifest: bytes = b"") -> bool:
+        """Ask the coordinator to authorize a direct rank-to-rank stream of
+        ``nbytes`` toward ``dst_rank``'s bulk listener.  The answering
+        ticket arrives asynchronously via :meth:`ticket_poll`.  Returns
+        False on single-process jobs (no peers) or when the send failed."""
+        return bool(self._lib.hvd_ticket_request(self._ptr, dst_rank, step,
+                                                 nbytes, manifest))
+
+    def ticket_poll(self) -> dict | None:
+        """Pop the next coordinator-issued transfer ticket::
+
+            {"transfer_id": 7, "token": 0x..., "src_rank": 1,
+             "dst_rank": 2, "dst_host": "127.0.0.1", "dst_port": 40001,
+             "step": 100, "epoch": 0, "manifest": b"..."}
+
+        ``dst_port == 0`` means the destination advertised no bulk
+        listener — use the coordinator relay instead.  ``None`` when no
+        ticket is queued."""
+        buf = ctypes.create_string_buffer(1 << 14)
+        n = self._lib.hvd_ticket_poll(self._ptr, buf, len(buf))
+        if n < -1:
+            buf = ctypes.create_string_buffer(-n + 16)
+            n = self._lib.hvd_ticket_poll(self._ptr, buf, len(buf))
+        if n <= 0:
+            return None
+        raw = buf.raw[:n]
+        (transfer_id, token, src_rank, dst_rank, dst_port, step,
+         epoch) = struct.unpack_from("<qqiiiqq", raw, 0)
+        off = 44
+        hln = struct.unpack_from("<i", raw, off)[0]
+        off += 4
+        dst_host = raw[off:off + hln].decode()
+        off += hln
+        mln = struct.unpack_from("<i", raw, off)[0]
+        off += 4
+        manifest = raw[off:off + mln]
+        return {"transfer_id": transfer_id, "token": token & 0xFFFFFFFFFFFFFFFF,
+                "src_rank": src_rank, "dst_rank": dst_rank,
+                "dst_host": dst_host, "dst_port": dst_port, "step": step,
+                "epoch": epoch, "manifest": manifest}
+
     def coord_state(self) -> dict | None:
         """The last coordinator-state delta this rank has seen
         (docs/fault_tolerance.md "Coordinator failover"): the coordinator's
@@ -799,9 +856,19 @@ def get_engine() -> NativeEngine:
 
             host = os.environ.get("HVD_TPU_COORDINATOR_HOST")
             port = int(os.environ.get("HVD_TPU_COORDINATOR_PORT", "0") or 0)
+            bulk_port = 0
+            if basics.size() > 1 and env.bulk_plane():
+                # Bind the process-global bulk listener BEFORE the engine
+                # exists so its port rides this rank's HELLO advertisement.
+                try:
+                    from horovod_tpu import dataplane
+                    bulk_port = dataplane.ensure_listener()
+                except Exception:
+                    bulk_port = 0  # no direct path; transfers fall to relay
             _engine = NativeEngine(basics.rank(), basics.size(),
                                    coordinator_host=host,
-                                   coordinator_port=port)
+                                   coordinator_port=port,
+                                   bulk_port=bulk_port)
             if _engine._verify_enabled:
                 # Schedule checkpoints recorded before the engine existed
                 # (compiled-path traces during warmup) join the stream now.

@@ -67,11 +67,12 @@ void* hvd_create(int rank, int size, double cycle_ms,
                  double stall_abort_seconds, int stall_abort_exit_code,
                  int verify_schedule, int verify_interval_ticks,
                  long long epoch, const char* timeline_path,
-                 const char* coord_host, int coord_port) {
+                 const char* coord_host, int coord_port, int bulk_port) {
   EngineOptions opts;
   opts.rank = rank;
   opts.size = size;
   opts.epoch = epoch;
+  opts.bulk_listen_port = bulk_port;
   opts.cycle_time_ms = cycle_ms;
   opts.fusion_threshold_bytes = fusion_threshold;
   opts.cache_capacity = cache_capacity >= 0 ? cache_capacity : 0;
@@ -378,6 +379,55 @@ int hvd_shard_ack_poll(void* e, long long* out) {
   out[2] = ack.step;
   out[3] = ack.epoch;
   return 1;
+}
+
+// Bulk data plane (docs/fault_tolerance.md "Bulk data plane").
+// hvd_ticket_request asks the coordinator to authorize a direct stream of
+// `nbytes` to dst_rank (manifest: opaque shard-set description echoed back
+// in the ticket).  Returns 1 when the request was sent/self-issued, 0 when
+// the plane has no peers or the send failed.
+int hvd_ticket_request(void* e, int dst_rank, long long step,
+                       long long nbytes, const char* manifest) {
+  std::string m = manifest != nullptr ? manifest : "";
+  return static_cast<Engine*>(e)->TicketRequestSend(dst_rank, step, nbytes, m)
+             ? 1
+             : 0;
+}
+
+// Pop the next issued ticket, serialized as {i64 transfer_id, i64 token,
+// i32 src_rank, i32 dst_rank, i32 dst_port, i64 step, i64 epoch,
+// str dst_host, str manifest}.  Returns bytes written, 0 when none is
+// queued, or -needed-1 when buflen is too small (grow-and-retry — the
+// ticket stays queued).
+// Deterministic transfer token (message.cc BulkToken), exported so the
+// Python data plane's mirror implementation can be pinned bit-for-bit by
+// tests — receiver-side stream validation depends on exact parity.
+unsigned long long hvd_bulk_token(long long transfer_id, long long epoch,
+                                  int src_rank, int dst_rank) {
+  return hvd::BulkToken(transfer_id, epoch, src_rank, dst_rank);
+}
+
+int hvd_ticket_poll(void* e, char* buf, int buflen) {
+  auto* eng = static_cast<Engine*>(e);
+  hvd::Ticket t;
+  if (!eng->TicketPoll(&t)) return 0;
+  Writer w;
+  w.i64(t.transfer_id);
+  w.i64(static_cast<int64_t>(t.token));
+  w.i32(t.src_rank);
+  w.i32(t.dst_rank);
+  w.i32(t.dst_port);
+  w.i64(t.step);
+  w.i64(t.epoch);
+  w.str(t.dst_host);
+  w.str(t.manifest);
+  if (static_cast<int>(w.buf.size()) > buflen) {
+    int needed = static_cast<int>(w.buf.size());
+    eng->TicketRequeue(std::move(t));
+    return -needed - 1;
+  }
+  std::memcpy(buf, w.buf.data(), w.buf.size());
+  return static_cast<int>(w.buf.size());
 }
 
 // Python acknowledges the resize: the stopped engine may be destroyed and

@@ -206,7 +206,7 @@ int TcpControlPlane::BindListener(int* port, std::string* err) {
 }
 
 std::unique_ptr<TcpControlPlane> TcpControlPlane::MakeCoordinator(
-    int port, int size, int64_t epoch, std::string* err) {
+    int port, int size, int64_t epoch, std::string* err, int bulk_port) {
   std::unique_ptr<TcpControlPlane> cp(new TcpControlPlane());
   cp->coordinator_ = true;
   cp->rank_ = 0;
@@ -219,6 +219,14 @@ std::unique_ptr<TcpControlPlane> TcpControlPlane::MakeCoordinator(
   if (cp->listen_fd_ < 0) return nullptr;
   int one = 1;
   cp->worker_fds_.assign(static_cast<size_t>(size > 0 ? size - 1 : 0), -1);
+  // Bulk data plane endpoint table, indexed by rank ([0] = the
+  // coordinator's own Python-side listener; workers advertise theirs in
+  // HELLO).  Ticket issuance resolves dst endpoints from here.
+  cp->peer_hosts_.assign(static_cast<size_t>(size > 0 ? size : 1),
+                         "127.0.0.1");
+  cp->bulk_ports_.assign(static_cast<size_t>(size > 0 ? size : 1), 0);
+  cp->own_bulk_port_ = bulk_port;
+  cp->bulk_ports_[0] = bulk_port;
   // Succession bookkeeping: each admitted worker's HELLO advertises its
   // pre-bound standby listen port (0 = none); its address comes from the
   // accepted connection itself.
@@ -334,13 +342,16 @@ std::unique_ptr<TcpControlPlane> TcpControlPlane::MakeCoordinator(
       *err = skew;
       return nullptr;
     }
+    // 12-byte HELLO {rank, standby_port, bulk_port}; the pre-data-plane
+    // 8-byte form is still accepted (bulk_port = 0: no direct streams to
+    // that peer, its transfers ride the coordinator relay).
     hello_ok = hello_hdr.type == static_cast<uint8_t>(FrameType::HELLO) &&
-               hello_hdr.payload_len == 8;
+               (hello_hdr.payload_len == 8 || hello_hdr.payload_len == 12);
     if (hello_ok) {
-      hello.resize(8);
+      hello.resize(hello_hdr.payload_len);
       ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
-      hello_ok = RecvAll(fd, hello.data(), 8) &&
-                 Crc32(hello.data(), 8) == hello_hdr.crc32;
+      hello_ok = RecvAll(fd, hello.data(), hello.size()) &&
+                 Crc32(hello.data(), hello.size()) == hello_hdr.crc32;
       ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &zero, sizeof(zero));
     }
     if (!hello_ok) {
@@ -357,6 +368,11 @@ std::unique_ptr<TcpControlPlane> TcpControlPlane::MakeCoordinator(
     int32_t standby_port = 0;
     std::memcpy(&standby_port, hello.data() + 4, 4);
     standby_ports[rank - 1] = standby_port;
+    if (hello.size() >= 12) {
+      int32_t bp = 0;
+      std::memcpy(&bp, hello.data() + 8, 4);
+      cp->bulk_ports_[rank] = bp;
+    }
     sockaddr_in peer{};
     socklen_t plen = sizeof(peer);
     char host_buf[INET_ADDRSTRLEN] = "127.0.0.1";
@@ -364,6 +380,16 @@ std::unique_ptr<TcpControlPlane> TcpControlPlane::MakeCoordinator(
       ::inet_ntop(AF_INET, &peer.sin_addr, host_buf, sizeof(host_buf));
     }
     peer_hosts[rank - 1] = host_buf;
+    cp->peer_hosts_[rank] = host_buf;
+    // The address workers reach THIS host at (for tickets naming the
+    // coordinator as dst): the local side of any accepted connection.
+    sockaddr_in self{};
+    socklen_t slen = sizeof(self);
+    char self_buf[INET_ADDRSTRLEN];
+    if (::getsockname(fd, reinterpret_cast<sockaddr*>(&self), &slen) == 0 &&
+        ::inet_ntop(AF_INET, &self.sin_addr, self_buf, sizeof(self_buf))) {
+      cp->peer_hosts_[0] = self_buf;
+    }
     cp->worker_fds_[rank - 1] = fd;
     if (!cp->SendTypedFrame(fd, FrameType::HELLO_ACK, "", rank)) {
       *err = "hello ack send failed to rank " + std::to_string(rank);
@@ -408,10 +434,11 @@ std::unique_ptr<TcpControlPlane> TcpControlPlane::MakeCoordinator(
 
 std::unique_ptr<TcpControlPlane> TcpControlPlane::MakeWorker(
     const std::string& host, int port, int rank, int64_t epoch,
-    std::string* err, bool standby) {
+    std::string* err, bool standby, int bulk_port) {
   std::unique_ptr<TcpControlPlane> cp(new TcpControlPlane());
   cp->coordinator_ = false;
   cp->rank_ = rank;
+  cp->own_bulk_port_ = bulk_port;
   cp->epoch_ = static_cast<uint16_t>(epoch & 0xFFFF);
   cp->wire_version_ = WireVersionFromEnv();
   cp->fault_ = ParseWireFaultEnv(epoch);
@@ -480,11 +507,13 @@ std::unique_ptr<TcpControlPlane> TcpControlPlane::MakeWorker(
       soft_err = "connect refused/unreachable";
       continue;
     }
-    std::string hello(8, '\0');
+    std::string hello(12, '\0');
     int32_t r32 = rank;
     int32_t sp32 = cp->standby_listen_port_;
+    int32_t bp32 = cp->own_bulk_port_;
     std::memcpy(hello.data(), &r32, 4);
     std::memcpy(hello.data() + 4, &sp32, 4);
+    std::memcpy(hello.data() + 8, &bp32, 4);
     if (!cp->SendTypedFrame(cp->sock_, FrameType::HELLO, hello, 0)) {
       ::close(cp->sock_);
       cp->sock_ = -1;
@@ -749,6 +778,92 @@ bool TcpControlPlane::PollShardAck(ShardAck* out) {
   return true;
 }
 
+bool TcpControlPlane::RequestTicket(const TicketRequest& req) {
+  if (failed_.load()) return false;
+  if (coordinator_) {
+    // The coordinator requesting a transfer authorizes itself: mint the
+    // ticket straight into its own inbox, no wire round trip.
+    IssueTicket(req);
+    return true;
+  }
+  std::string payload;
+  Serialize(req, &payload);
+  return sock_ >= 0 &&
+         SendTypedFrame(sock_, FrameType::TICKET_REQ, payload, 0);
+}
+
+bool TcpControlPlane::PollTicket(Ticket* out) {
+  std::lock_guard<std::mutex> l(state_mu_);
+  if (ticket_inbox_.empty()) return false;
+  *out = std::move(ticket_inbox_.front());
+  ticket_inbox_.pop_front();
+  return true;
+}
+
+void TcpControlPlane::RequeueTicket(Ticket&& ticket) {
+  std::lock_guard<std::mutex> l(state_mu_);
+  ticket_inbox_.push_front(std::move(ticket));
+}
+
+void TcpControlPlane::IssueTicket(const TicketRequest& req) {
+  Ticket t;
+  t.transfer_id = next_transfer_id_.fetch_add(1);
+  t.src_rank = req.src_rank;
+  t.dst_rank = req.dst_rank;
+  t.step = req.step;
+  t.epoch = req.epoch;
+  t.manifest = req.manifest;
+  if (req.dst_rank >= 0 &&
+      static_cast<size_t>(req.dst_rank) < bulk_ports_.size()) {
+    t.dst_port = bulk_ports_[static_cast<size_t>(req.dst_rank)];
+    t.dst_host = peer_hosts_[static_cast<size_t>(req.dst_rank)];
+  }
+  // dst_port stays 0 when the destination advertised no bulk listener:
+  // the requester reads that as "no direct path, use the relay".
+  t.token = BulkToken(t.transfer_id, t.epoch, t.src_rank, t.dst_rank);
+  if (req.src_rank == rank_) {
+    std::lock_guard<std::mutex> l(state_mu_);
+    ticket_inbox_.push_back(std::move(t));
+    if (ticket_inbox_.size() > kShardInboxCap) ticket_inbox_.pop_front();
+    return;
+  }
+  int idx = req.src_rank - 1;
+  if (idx < 0 || static_cast<size_t>(idx) >= worker_fds_.size()) return;
+  int fd = worker_fds_[static_cast<size_t>(idx)];
+  if (fd < 0) return;
+  std::string payload;
+  Serialize(t, &payload);
+  SendTypedFrame(fd, FrameType::TICKET, payload, req.src_rank);
+}
+
+bool TcpControlPlane::HandleTicketFrame(FrameType t, const std::string& body,
+                                        int from_rank) {
+  if (t == FrameType::TICKET_REQ) {
+    TicketRequest req;
+    if (!Deserialize(body.data(), body.size(), &req)) {
+      RecordFailure(from_rank, "frame_corrupt",
+                    "undecodable TICKET_REQ frame from rank " +
+                        std::to_string(from_rank));
+      return false;
+    }
+    // Only the coordinator mints tickets; a TICKET_REQ that reaches a
+    // worker (misrouted) is absorbed without effect.
+    if (coordinator_) IssueTicket(req);
+    return true;
+  }
+  Ticket ticket;
+  if (!Deserialize(body.data(), body.size(), &ticket)) {
+    RecordFailure(from_rank, "frame_corrupt",
+                  "undecodable TICKET frame from rank " +
+                      std::to_string(from_rank));
+    return false;
+  }
+  std::lock_guard<std::mutex> l(state_mu_);
+  ticket_inbox_.push_back(std::move(ticket));
+  if (ticket_inbox_.size() > kShardInboxCap) ticket_inbox_.pop_front();
+  return true;
+}
+
 bool TcpControlPlane::HandleShardFrame(FrameType t, const std::string& body,
                                        int from_rank) {
   if (t == FrameType::SHARD_ACK) {
@@ -975,6 +1090,11 @@ bool TcpControlPlane::RecvDataFrame(int fd, int peer_rank, FrameType expect,
       // stream like heartbeats; an undecodable one recorded a structured
       // frame_corrupt failure.
       if (!HandleShardFrame(t, body, peer_rank)) return false;
+      continue;
+    }
+    if (t == FrameType::TICKET || t == FrameType::TICKET_REQ) {
+      // Bulk-transfer tickets interleave the same way.
+      if (!HandleTicketFrame(t, body, peer_rank)) return false;
       continue;
     }
     if (t == FrameType::ABORT) {
@@ -1345,6 +1465,12 @@ bool TcpControlPlane::Gather(const RequestList& own,
           // peer-replicated checkpointing"): forward/accept and keep
           // draining — these interleave with REQUEST traffic.
           if (!HandleShardFrame(t, f.buf, wrank)) return false;
+          f = FrameState{};
+          continue;
+        }
+        if (t == FrameType::TICKET_REQ || t == FrameType::TICKET) {
+          // Bulk-transfer ticket requests: issue and answer, keep draining.
+          if (!HandleTicketFrame(t, f.buf, wrank)) return false;
           f = FrameState{};
           continue;
         }

@@ -108,6 +108,16 @@ class ControlPlane {
   // grow-and-retry path: the caller's buffer was too small).
   virtual void RequeueShard(ShardPut&& /*shard*/) {}
   virtual bool PollShardAck(ShardAck* /*out*/) { return false; }
+
+  // Bulk data plane (docs/fault_tolerance.md "Bulk data plane").
+  // RequestTicket asks the coordinator to authorize a direct rank-to-rank
+  // stream (TICKET_REQ frame; the coordinator answers the requester with a
+  // TICKET frame carrying the dst endpoint + transfer token).  PollTicket
+  // pops the next issued ticket; RequeueTicket returns one (grow-and-retry).
+  // The loopback plane has no peers to stream to.
+  virtual bool RequestTicket(const TicketRequest& /*req*/) { return false; }
+  virtual bool PollTicket(Ticket* /*out*/) { return false; }
+  virtual void RequeueTicket(Ticket&& /*ticket*/) {}
 };
 
 // Single-process transport: Exchange/Gather/Broadcast are pass-throughs.
@@ -143,9 +153,13 @@ class TcpControlPlane : public ControlPlane {
   // membership): stamped into every frame header and enforced at the HELLO
   // handshake, so stragglers from an older membership are rejected instead
   // of admitted.
+  // ``bulk_port``: the Python-side bulk data-plane listener this rank
+  // pre-bound (0 = none); advertised in HELLO so the coordinator can issue
+  // tickets naming the destination's endpoint.
   static std::unique_ptr<TcpControlPlane> MakeCoordinator(int port, int size,
                                                           int64_t epoch,
-                                                          std::string* err);
+                                                          std::string* err,
+                                                          int bulk_port = 0);
   // ``standby``: pre-bind an ephemeral succession listener before the
   // handshake and advertise its port in HELLO, so this worker can be
   // promoted to coordinator without out-of-band discovery (elastic jobs;
@@ -154,7 +168,8 @@ class TcpControlPlane : public ControlPlane {
                                                      int port, int rank,
                                                      int64_t epoch,
                                                      std::string* err,
-                                                     bool standby = false);
+                                                     bool standby = false,
+                                                     int bulk_port = 0);
   // Bind+listen a TCP socket on `port` (0 = kernel-assigned); on success
   // returns the fd and writes the bound port back through *port.  Shared by
   // rendezvous, the standby pre-bind, and star_bench's port selection.
@@ -185,6 +200,10 @@ class TcpControlPlane : public ControlPlane {
   bool PollShard(ShardPut* out) override;
   void RequeueShard(ShardPut&& shard) override;
   bool PollShardAck(ShardAck* out) override;
+
+  bool RequestTicket(const TicketRequest& req) override;
+  bool PollTicket(Ticket* out) override;
+  void RequeueTicket(Ticket&& ticket) override;
   // Worker: port of the pre-bound succession listener (0 = none).  The
   // engine surfaces it as the elastic worker's bound_port so Python can
   // re-bind the same endpoint when this rank is promoted.
@@ -222,6 +241,14 @@ class TcpControlPlane : public ControlPlane {
   // enqueue it, and generate the coordinator-side SHARD_ACK.  Returns
   // false on an undecodable body (recorded as frame_corrupt).
   bool HandleShardFrame(FrameType t, const std::string& body, int from_rank);
+  // Ticket demux: TICKET_REQ at the coordinator (issue + answer requester),
+  // TICKET at a worker (enqueue into ticket_inbox_).  Returns false on an
+  // undecodable body (recorded as frame_corrupt).
+  bool HandleTicketFrame(FrameType t, const std::string& body, int from_rank);
+  // Coordinator: mint a Ticket for `req` (dst endpoint from the HELLO
+  // advertisements, token from BulkToken) and deliver it to the requester —
+  // over the wire for a worker, straight into ticket_inbox_ for itself.
+  void IssueTicket(const TicketRequest& req);
   void RecordFailure(int peer_rank, const char* cause, std::string detail);
   void RecordAbort(const PeerFailureReport& report);
   void RecordReconfig(const ReconfigInfo& info);
@@ -273,6 +300,15 @@ class TcpControlPlane : public ControlPlane {
   // reader that stopped polling cannot balloon the host heap.
   std::deque<ShardPut> shard_inbox_;
   std::deque<ShardAck> shard_acks_;
+  // Bulk data plane (guarded by state_mu_).  Coordinator: per-rank bulk
+  // listener endpoints learned at HELLO (index = rank, [0] = its own) and
+  // the monotonically increasing transfer-id mint.  Both sides: tickets
+  // issued to THIS rank, awaiting a PollTicket.
+  std::vector<std::string> peer_hosts_;   // coordinator: index = rank
+  std::vector<int32_t> bulk_ports_;       // coordinator: index = rank
+  std::deque<Ticket> ticket_inbox_;
+  int own_bulk_port_ = 0;
+  std::atomic<long long> next_transfer_id_{1};
 
   uint8_t wire_version_ = kWireVersion;  // HVD_TPU_WIRE_VERSION override
   WireFaultSpec fault_;

@@ -47,7 +47,8 @@ Status Engine::Start(int* bound_port) {
   } else if (opts_.rank == 0) {
     std::string err;
     auto cp = TcpControlPlane::MakeCoordinator(opts_.coordinator_port,
-                                               opts_.size, opts_.epoch, &err);
+                                               opts_.size, opts_.epoch, &err,
+                                               opts_.bulk_listen_port);
     if (!cp) return Status::Unknown("control plane: " + err);
     if (bound_port != nullptr) *bound_port = cp->bound_port();
     control_ = std::move(cp);
@@ -58,7 +59,8 @@ Status Engine::Start(int* bound_port) {
     // port so Python can re-bind the same endpoint on promotion.
     auto cp = TcpControlPlane::MakeWorker(opts_.coordinator_host,
                                           opts_.coordinator_port, opts_.rank,
-                                          opts_.epoch, &err, opts_.elastic);
+                                          opts_.epoch, &err, opts_.elastic,
+                                          opts_.bulk_listen_port);
     if (!cp) return Status::Unknown("control plane: " + err);
     if (bound_port != nullptr) *bound_port = cp->standby_listen_port();
     control_ = std::move(cp);
@@ -996,6 +998,27 @@ void Engine::ShardRequeue(ShardPut&& shard) {
 
 bool Engine::ShardAckPoll(ShardAck* out) {
   return control_ && control_->PollShardAck(out);
+}
+
+bool Engine::TicketRequestSend(int32_t dst_rank, int64_t step, int64_t nbytes,
+                               const std::string& manifest) {
+  if (!control_ || stopped_.load()) return false;
+  TicketRequest req;
+  req.src_rank = opts_.rank;
+  req.dst_rank = dst_rank;
+  req.step = step;
+  req.epoch = opts_.epoch;
+  req.nbytes = nbytes;
+  req.manifest = manifest;
+  return control_->RequestTicket(req);
+}
+
+bool Engine::TicketPoll(Ticket* out) {
+  return control_ && control_->PollTicket(out);
+}
+
+void Engine::TicketRequeue(Ticket&& ticket) {
+  if (control_) control_->RequeueTicket(std::move(ticket));
 }
 
 bool Engine::PollHandle(int64_t handle) {

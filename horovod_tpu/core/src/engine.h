@@ -107,6 +107,10 @@ struct EngineOptions {
   std::string timeline_path;      // empty = disabled
   std::string coordinator_host;   // workers (rank>0)
   int coordinator_port = 0;       // 0 = pick ephemeral (coordinator)
+  // Bulk data-plane listener this rank's Python side pre-bound (0 = no
+  // data plane): advertised in HELLO so the coordinator can issue
+  // rank-to-rank transfer tickets naming this endpoint.
+  int bulk_listen_port = 0;
 };
 
 class Engine {
@@ -228,6 +232,15 @@ class Engine {
   bool ShardPoll(ShardPut* out);
   void ShardRequeue(ShardPut&& shard);  // undo a poll (buffer too small)
   bool ShardAckPoll(ShardAck* out);
+
+  // Bulk data plane (docs/fault_tolerance.md "Bulk data plane"): ask the
+  // coordinator to authorize a direct rank-to-rank stream to dst_rank, and
+  // poll the answering Ticket (the dst endpoint + transfer token).  Both
+  // non-blocking; false on loopback jobs.
+  bool TicketRequestSend(int32_t dst_rank, int64_t step, int64_t nbytes,
+                         const std::string& manifest);
+  bool TicketPoll(Ticket* out);
+  void TicketRequeue(Ticket&& ticket);  // undo a poll (buffer too small)
 
   // Handle table (reference torch/handle_manager.{h,cc}).
   bool PollHandle(int64_t handle);                 // true = done

@@ -444,4 +444,69 @@ bool Deserialize(const char* data, size_t len, ShardAck* out) {
   return !r.fail;
 }
 
+void Serialize(const TicketRequest& in, std::string* out) {
+  Writer w{out};
+  w.i32(in.src_rank);
+  w.i32(in.dst_rank);
+  w.i64(in.step);
+  w.i64(in.epoch);
+  w.i64(in.nbytes);
+  w.str(in.manifest);
+}
+
+bool Deserialize(const char* data, size_t len, TicketRequest* out) {
+  Reader r{data, len};
+  out->src_rank = r.i32();
+  out->dst_rank = r.i32();
+  out->step = r.i64();
+  out->epoch = r.i64();
+  out->nbytes = r.i64();
+  out->manifest = r.str();
+  return !r.fail;
+}
+
+void Serialize(const Ticket& in, std::string* out) {
+  Writer w{out};
+  w.i64(in.transfer_id);
+  w.u64(in.token);
+  w.i32(in.src_rank);
+  w.i32(in.dst_rank);
+  w.str(in.dst_host);
+  w.i32(in.dst_port);
+  w.i64(in.step);
+  w.i64(in.epoch);
+  w.str(in.manifest);
+}
+
+bool Deserialize(const char* data, size_t len, Ticket* out) {
+  Reader r{data, len};
+  out->transfer_id = r.i64();
+  out->token = r.u64();
+  out->src_rank = r.i32();
+  out->dst_rank = r.i32();
+  out->dst_host = r.str();
+  out->dst_port = r.i32();
+  out->step = r.i64();
+  out->epoch = r.i64();
+  out->manifest = r.str();
+  return !r.fail;
+}
+
+uint64_t BulkToken(int64_t transfer_id, int64_t epoch, int32_t src_rank,
+                   int32_t dst_rank) {
+  // splitmix64-style avalanche over the public tuple; NOT a secret — it
+  // guards against stream misdelivery and stale/forged transfer ids, the
+  // same threat model as the CRC-framed control plane.
+  uint64_t x = static_cast<uint64_t>(transfer_id) * 0x9E3779B97F4A7C15ULL;
+  x ^= static_cast<uint64_t>(epoch) + 0xBF58476D1CE4E5B9ULL +
+       (static_cast<uint64_t>(static_cast<uint32_t>(src_rank)) << 32) +
+       static_cast<uint64_t>(static_cast<uint32_t>(dst_rank));
+  x ^= x >> 30;
+  x *= 0xBF58476D1CE4E5B9ULL;
+  x ^= x >> 27;
+  x *= 0x94D049BB133111EBULL;
+  x ^= x >> 31;
+  return x;
+}
+
 }  // namespace hvd

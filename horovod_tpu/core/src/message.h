@@ -152,7 +152,8 @@ constexpr uint8_t kWireVersion = 1;
 
 enum class FrameType : uint8_t {
   HELLO = 1,      // worker -> coordinator at connect: {i32 rank,
-                  // i32 standby_listen_port (0 = none pre-bound)}
+                  // i32 standby_listen_port (0 = none pre-bound),
+                  // i32 bulk_listen_port (0 = no data plane)}
   HELLO_ACK = 2,  // coordinator -> worker: empty = accepted, else error text
   REQUEST = 3,    // RequestList (worker -> coordinator, every cycle)
   RESPONSE = 4,   // ResponseList (coordinator -> workers)
@@ -172,6 +173,12 @@ enum class FrameType : uint8_t {
                    // (docs/fault_tolerance.md "Async & peer-replicated
                    // checkpointing")
   SHARD_ACK = 13,  // ShardAck: the control plane accepted/relayed the shard
+  TICKET_REQ = 14,  // TicketRequest: a rank asking the coordinator to
+                    // authorize a rank-to-rank bulk transfer
+                    // (docs/fault_tolerance.md "Bulk data plane")
+  TICKET = 15,      // Ticket: the coordinator's authorization — the dst
+                    // endpoint plus a transfer id/token the receiver can
+                    // validate without ever seeing the ticket itself
 };
 
 // 16-byte little-endian header preceding every frame payload.  ``flags``
@@ -320,5 +327,51 @@ struct ShardAck {
 
 void Serialize(const ShardAck& in, std::string* out);
 bool Deserialize(const char* data, size_t len, ShardAck* out);
+
+// Bulk-transfer authorization request (docs/fault_tolerance.md "Bulk data
+// plane"): src asks the coordinator for a ticket to stream ``nbytes`` of
+// shard payload directly to dst's bulk listener.  ``manifest`` is an opaque
+// Python-side description of the shard set (offsets/lengths/CRCs) echoed
+// back in the Ticket so the sender's stream header and the receiver's
+// validation agree on the same cut.
+struct TicketRequest {
+  int32_t src_rank = -1;
+  int32_t dst_rank = -1;
+  int64_t step = -1;
+  int64_t epoch = 0;
+  int64_t nbytes = 0;
+  std::string manifest;
+};
+
+void Serialize(const TicketRequest& in, std::string* out);
+bool Deserialize(const char* data, size_t len, TicketRequest* out);
+
+// The coordinator's bulk-transfer authorization, sent back to the REQUESTING
+// rank only.  The receiver never needs a ticket delivered: the token is a
+// deterministic mix of {transfer_id, epoch, src, dst} (BulkToken below) that
+// both sides compute independently, so an inbound stream validates against
+// recomputation — no ticket/stream delivery race.  ``dst_port == 0`` means
+// the destination advertised no bulk listener: use the coordinator relay.
+struct Ticket {
+  int64_t transfer_id = 0;
+  uint64_t token = 0;
+  int32_t src_rank = -1;
+  int32_t dst_rank = -1;
+  std::string dst_host;
+  int32_t dst_port = 0;
+  int64_t step = -1;
+  int64_t epoch = 0;
+  std::string manifest;
+};
+
+void Serialize(const Ticket& in, std::string* out);
+bool Deserialize(const char* data, size_t len, Ticket* out);
+
+// The deterministic transfer token: both the ticket issuer and the stream
+// receiver compute it from public fields, so possession of a matching token
+// proves the sender holds a coordinator-issued ticket for THIS (id, epoch,
+// src, dst) tuple.  Mirrored bit-for-bit in Python (dataplane._token).
+uint64_t BulkToken(int64_t transfer_id, int64_t epoch, int32_t src_rank,
+                   int32_t dst_rank);
 
 }  // namespace hvd

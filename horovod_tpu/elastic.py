@@ -270,10 +270,22 @@ def reconfigure(eng=None) -> ResizeEvent:
     # across a reconfiguration THIS process participated in: re-stamp them
     # to the new epoch so a disk-free restore can still use them.  A
     # straggler that missed the reconfig never gets here, so its stale
-    # stamps are rejected (replication.best) and it restores from disk.
+    # stamps are invisible to the shard-set election (replication.elect)
+    # and it restores from disk.
     from horovod_tpu import replication as _replication
 
     _replication.bump_epoch(ev.epoch)
+    # Re-shard under the new membership: each survivor re-ships its held
+    # shards of the newest step to its NEW ring partner, restoring the
+    # two-holders-per-shard redundancy the departed rank may have broken.
+    # Best effort — a failed ship leaves disk as the last resort, and a
+    # failure here must never turn a successful reconfiguration into a
+    # crash.
+    if _replication.enabled():
+        try:
+            _replication.reshard(new_eng)
+        except Exception:
+            pass
     if ev.new_rank == 0:
         # The (possibly newly promoted) coordinator republishes its
         # endpoint so late joiners and the launcher's single-rank relaunch

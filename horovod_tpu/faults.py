@@ -65,6 +65,15 @@ Injectors (all opt-in; absent env == no faults):
   to 2 while the isolated ex-coordinator, unable to reach a quorum above
   the floor, takes the structured exit-75 abort
   (tests/test_elastic_reconfig.py coordinator chaos soak).
+* ``HVD_TPU_FAULT_BULK_{DROP,CORRUPT,TRUNCATE}`` = ``"<rank>[:<nth>]"``
+  — data-plane chaos against the rank-to-rank bulk streams
+  (dataplane.py): rank <rank>'s <nth> bulk SEND (0-based; default 0, the
+  first) silently vanishes after the ticket is consumed (DROP), carries
+  one flipped chunk CRC the receiver must reject (CORRUPT), or closes
+  the socket mid-stream leaving a truncated payload (TRUNCATE).  Every
+  case must land on the fallback chain — direct -> coordinator relay ->
+  disk — with survivors bit-exact, never a hang or a torn shard set
+  (tests/test_dataplane.py chaos soak).
 * ``HVD_TPU_FAULT_ON_ATTEMPT`` (default 0) — faults fire only when the
   launcher-exported ``HVD_TPU_RESTART_ATTEMPT`` matches, so an injected
   crash consumes exactly one restart and the relaunched job runs clean.
@@ -118,6 +127,9 @@ class FaultPlan:
     wire_corrupt: tuple[int, int, int] | None = None
     wire_partition: tuple[int, int, int] | None = None
     wire_halfclose: tuple[int, int, int] | None = None
+    bulk_drop: tuple[int, int] | None = None
+    bulk_corrupt: tuple[int, int] | None = None
+    bulk_truncate: tuple[int, int] | None = None
     on_attempt: int = 0
 
     def any_active(self) -> bool:
@@ -126,7 +138,8 @@ class FaultPlan:
             self.corrupt_step, self.persist_kill_step,
             self.torn_manifest_step, self.enospc_step, self.slow_disk_ms,
             self.wire_drop, self.wire_corrupt,
-            self.wire_partition, self.wire_halfclose))
+            self.wire_partition, self.wire_halfclose,
+            self.bulk_drop, self.bulk_corrupt, self.bulk_truncate))
 
 
 def _int_env(name: str) -> int | None:
@@ -146,6 +159,16 @@ def _wire_env(name: str) -> tuple[int, int, int] | None:
     raw, _, epoch_s = raw.partition("@")
     rank_s, _, frame_s = raw.partition(":")
     return int(rank_s), int(frame_s or 0), int(epoch_s or 0)
+
+
+def _bulk_env(name: str) -> tuple[int, int] | None:
+    """Parse a bulk injector's ``"<rank>[:<nth>]"`` value (nth 0 when
+    omitted) — which of the rank's bulk sends the fault hits."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return None
+    rank_s, _, nth_s = raw.partition(":")
+    return int(rank_s), int(nth_s or 0)
 
 
 def _plan_from_env() -> FaultPlan:
@@ -174,12 +197,16 @@ def _plan_from_env() -> FaultPlan:
         wire_corrupt=_wire_env("HVD_TPU_FAULT_WIRE_CORRUPT"),
         wire_partition=_wire_env("HVD_TPU_FAULT_WIRE_PARTITION"),
         wire_halfclose=_wire_env("HVD_TPU_FAULT_WIRE_HALFCLOSE"),
+        bulk_drop=_bulk_env("HVD_TPU_FAULT_BULK_DROP"),
+        bulk_corrupt=_bulk_env("HVD_TPU_FAULT_BULK_CORRUPT"),
+        bulk_truncate=_bulk_env("HVD_TPU_FAULT_BULK_TRUNCATE"),
         on_attempt=_int_env("HVD_TPU_FAULT_ON_ATTEMPT") or 0,
     )
 
 
 _plan: FaultPlan | None = None
 _delay_fired = False
+_bulk_sends = 0
 
 
 def plan() -> FaultPlan:
@@ -192,17 +219,19 @@ def plan() -> FaultPlan:
 
 def install(**kwargs) -> FaultPlan:
     """Programmatic installation (tests, bench.py) — replaces the env plan."""
-    global _plan, _delay_fired
+    global _plan, _delay_fired, _bulk_sends
     _plan = FaultPlan(**kwargs)
     _delay_fired = False
+    _bulk_sends = 0
     return _plan
 
 
 def clear() -> None:
     """Drop any installed/cached plan; env is re-read on next use."""
-    global _plan, _delay_fired
+    global _plan, _delay_fired, _bulk_sends
     _plan = None
     _delay_fired = False
+    _bulk_sends = 0
 
 
 def _attempt() -> int:
@@ -257,6 +286,30 @@ def step(step_num: int, rank: int | None = None) -> None:
         os.kill(os.getpid(), p.kill_signal)
         time.sleep(60)  # SIGKILL needs no help; catchable signals get a
         os._exit(128 + p.kill_signal)  # bounded grace, then hard exit
+
+
+def on_bulk_send(rank: int | None = None) -> str | None:
+    """Data-plane hook, called by dataplane.send once per outgoing bulk
+    stream.  Returns the fault to apply to THIS send — ``"drop"``,
+    ``"corrupt"``, ``"truncate"`` — or None.  The send counter advances
+    whether or not a fault fires, so ``"<rank>:<nth>"`` plans hit exactly
+    the nth stream this process originates."""
+    global _bulk_sends
+    p = plan()
+    n = _bulk_sends
+    _bulk_sends += 1
+    if _attempt() != p.on_attempt:
+        return None
+    r = _rank(rank)
+    for kind, cfg in (("drop", p.bulk_drop), ("corrupt", p.bulk_corrupt),
+                      ("truncate", p.bulk_truncate)):
+        if cfg is not None and cfg[0] == r and cfg[1] == n:
+            sys.stderr.write(
+                f"horovod_tpu.faults: bulk-{kind} on rank {r} send #{n} "
+                f"(injected)\n")
+            sys.stderr.flush()
+            return kind
+    return None
 
 
 def on_checkpoint_persist(path: str, step_num: int,

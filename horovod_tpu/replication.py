@@ -1,72 +1,118 @@
-"""Host-memory peer replica store for checkpoint snapshots.
+"""ZeRO-sharded host-memory peer replica store for checkpoint snapshots.
 
 Peer replication (docs/fault_tolerance.md "Async & peer-replicated
-checkpointing") keeps a second copy of each rank's newest checkpoint
-snapshot in a *neighbor rank's host memory*: ``put`` pickles the snapshot
-and ships it over the control plane as a SHARD_PUT frame (relayed by the
-coordinator — the plane is a star), ``drain`` pulls received shards out of
-the native inbox into this module, and an elastic restore asks ``best``
-for the newest replica from the *current* membership epoch before it ever
-touches disk.
+checkpointing") keeps checkpoint state out of the disk's failure domain by
+spreading it across the membership's host memory.  Earlier rounds pickled
+each rank's WHOLE snapshot to one ring neighbor — per-rank replication
+traffic equal to the full state, all of it relayed through the rank-0
+coordinator star.  This round shards it ZeRO-style:
+
+* ``encode_snapshot`` flattens the state tree (a jax-free flattener —
+  dicts/lists/tuples; numpy leaves round-trip bit-exact), pickles each
+  leaf behind a ``<q`` length prefix, and prepends a skeleton blob
+  ``{step, treedef, metadata, n_leaves}``.
+* ``cut_shards`` cuts the encoded blob into equal BYTE ranges (the flat
+  partitioning ZeRO applies to optimizer state): ``cut = ceil(total/N)``,
+  shard *i* = bytes ``[i*cut, (i+1)*cut)``.
+* ``put`` keeps shard ``rank`` locally and ships THAT ONE shard to the
+  ring partner ``(rank+1) % size`` — per-rank replication bytes scale as
+  ~1/N of the old whole-tree push, and any single rank loss still leaves
+  a complete shard set among the survivors (each shard has two holders).
+* Shards travel over the rank-to-rank bulk data plane when the peer
+  advertised an endpoint (dataplane.py — coordinator-issued tickets,
+  direct CRC-framed streams, zero payload bytes through the coordinator),
+  falling back to the legacy SHARD_PUT coordinator relay, and ultimately
+  to disk (the checkpoint directory always has the data).
+
+Restore agreement (checkpoint._restore_from_peers) extends the PR-10
+view/elect protocol to shard SETS: every rank broadcasts an *inventory*
+view (``send_inventory`` — which shards of which steps it holds, at which
+cut), ``elect`` picks the newest step with a COMPLETE shard set across
+the union of announced inventories, ``ship_missing`` has the lowest-rank
+holder of each shard stream it to every rank that lacks it, and
+``assemble`` reassembles the byte ranges for ``decode_snapshot``.  A torn
+or incomplete set is never restored — the caller falls to disk.
+
+Sharded reassembly assumes the data-parallel invariant: every rank's
+snapshot of a given step encodes to the SAME byte stream (replicated
+parameters, broadcast-synchronised optimizer state).  Shard i from rank A
+concatenated with shard j from rank B is only a valid stream under that
+assumption — the same one the earlier whole-replica any-holder restore
+already relied on, now load-bearing per byte range rather than per blob.
 
 Why a Python module and not the C++ plane: an elastic reconfiguration
 (elastic.reconfigure) tears down and re-forms the NativeEngine, so nothing
 inside the C++ control plane survives a RECONFIG.  This store is plain
-process-global host memory — it survives the re-form, and
-``bump_epoch`` re-stamps the survivors' entries to the new epoch so they
-stay restorable.  A process that *missed* the reconfiguration keeps its
-old stamps; ``best`` rejects them and the restore falls back to disk —
-exactly the invalidation ISSUE semantics require (a stale replica must
-never win over a committed checkpoint from the new membership).
-
-Epoch flow: the native engine stamps its own epoch into every outbound
-SHARD_PUT (core/src/engine.cc), and the frame layer rejects cross-epoch
-frames on the wire, so every entry that lands here via ``drain`` carries
-the epoch the *plane* had when the snapshot was shipped.
+process-global host memory — it survives the re-form, ``bump_epoch``
+re-stamps the survivors' shards to the new epoch, and ``reshard`` re-ships
+held shards to the NEW ring partner so redundancy holds under the new
+membership.  A process that *missed* the reconfiguration keeps its old
+stamps; election ignores them and the restore falls back to disk — a
+stale replica must never win over a committed checkpoint from the new
+membership.
 
 Like faults.py this module is deliberately jax-free: the engine-only
 elastic workers the tests spawn import it without pulling in a device
-runtime.  Snapshots are pickled as-is — numpy trees round-trip bit-exact,
-which is what the restore parity test pins.
+runtime.
 """
 
 from __future__ import annotations
 
 import pickle
+import struct
 import threading
+import time
+import zlib
 from typing import Any, NamedTuple
 
 from horovod_tpu.core import engine as core_engine
 from horovod_tpu.utils import env
 
+_PICKLE = pickle.HIGHEST_PROTOCOL
 
-class ReplicaEntry(NamedTuple):
-    """One peer's newest snapshot held in local host memory."""
+
+class ShardEntry(NamedTuple):
+    """One byte-range shard of an encoded snapshot held in host memory."""
 
     owner_rank: int
     step: int
     epoch: int
+    shard_index: int
+    cut_size: int
+    total_len: int
     payload: bytes
+    via: str  # "local" | "direct" | "relay"
 
 
 _lock = threading.Lock()
-# owner_rank -> newest ReplicaEntry received from that owner.  One slot per
-# owner: a replica only exists to serve "newest restorable state", so older
-# shards are dropped on arrival.
-_replicas: dict[int, ReplicaEntry] = {}
+# (step, shard_index) -> newest ShardEntry.  Pruned to the two newest steps:
+# the newest may be incomplete mid-replication, so the previous complete set
+# must stay electable.
+_shards: dict[tuple[int, int], ShardEntry] = {}
 # Newest step the control plane has acknowledged accepting (relay/enqueue
 # succeeded).  Observability only — an ack is NOT end-to-end delivery.
 _last_acked_step: int = -1
 _puts: int = 0
 _drained: int = 0
+_direct_shards: int = 0
+_relay_shards: int = 0
+_direct_bytes: int = 0
+_relay_bytes: int = 0
+_disk_restores: int = 0
+# (epoch, dst) pairs whose ticket came back with dst_port == 0 — the peer
+# has no bulk listener this epoch, skip the ticket round-trip and relay.
+_no_bulk: set[tuple[int, int]] = set()
 
 # Restore-time agreement messages ride the same SHARD_PUT relay as the
-# replicas (the engine-only workers' data plane is identity — the control
-# plane is the only cross-process channel they have).  A view frame is a
-# magic-prefixed payload announcing the sender's best epoch-valid replica
-# step; drain() routes it here instead of the replica store.
-_VIEW_MAGIC = b"\x00hvdview1\x00"
-_views: dict[int, tuple[int, int]] = {}  # owner -> (replica_step, epoch)
+# fallback shards (the engine-only workers' data plane is identity — the
+# control plane is the only guaranteed cross-process channel).  An
+# inventory view is a magic-prefixed pickled dict
+# ``{step: {"cut": int, "total": int, "shards": [indices]}}``;
+# a relay shard is a magic-prefixed metadata header plus the byte range.
+_VIEW_MAGIC = b"\x00hvdview2\x00"
+_WRAP_MAGIC = b"\x00hvdshard2\x00"
+_WRAP_HDR = struct.Struct("<iiqqI")  # shard_index, src_rank, cut, total, crc
+_inventories: dict[int, tuple[dict, int]] = {}  # rank -> (inventory, epoch)
 
 
 def enabled() -> bool:
@@ -74,33 +120,240 @@ def enabled() -> bool:
 
 
 def target_rank(rank: int, size: int) -> int:
-    """The neighbor holding this rank's replica: the next rank mod size."""
+    """The ring partner holding this rank's shard: the next rank mod size."""
     return (rank + 1) % size
+
+
+# -- snapshot codec ---------------------------------------------------------
+
+
+def _flatten_tree(obj: Any) -> tuple[list, Any]:
+    """Jax-free tree flatten: dicts (sorted keys), lists, and plain tuples
+    are structure; everything else — numpy arrays, scalars, namedtuples —
+    is a leaf pickled whole."""
+    leaves: list = []
+
+    def go(x):
+        if isinstance(x, dict):
+            keys = sorted(x.keys(), key=repr)
+            return ("d", [(k, go(x[k])) for k in keys])
+        if isinstance(x, list):
+            return ("l", [go(v) for v in x])
+        if isinstance(x, tuple) and not hasattr(x, "_fields"):
+            return ("t", [go(v) for v in x])
+        leaves.append(x)
+        return "*"
+
+    treedef = go(obj)
+    return leaves, treedef
+
+
+def _unflatten_tree(treedef: Any, it) -> Any:
+    if treedef == "*":
+        return next(it)
+    tag, children = treedef
+    if tag == "d":
+        return {k: _unflatten_tree(c, it) for k, c in children}
+    vals = [_unflatten_tree(c, it) for c in children]
+    return vals if tag == "l" else tuple(vals)
+
+
+def encode_snapshot(step: int, state: Any,
+                    metadata: dict | None = None) -> bytes:
+    """Snapshot -> one byte blob: skeleton, then per-leaf pickles, each
+    behind a ``<q`` length prefix so the cut points never need to align
+    with value boundaries."""
+    leaves, treedef = _flatten_tree(state)
+    skeleton = pickle.dumps(
+        {"step": int(step), "treedef": treedef, "metadata": metadata or {},
+         "n_leaves": len(leaves)}, protocol=_PICKLE)
+    parts = [struct.pack("<q", len(skeleton)), skeleton]
+    for leaf in leaves:
+        blob = pickle.dumps(leaf, protocol=_PICKLE)
+        parts.append(struct.pack("<q", len(blob)))
+        parts.append(blob)
+    return b"".join(parts)
+
+
+def decode_snapshot(blob: bytes) -> dict:
+    """Inverse of :func:`encode_snapshot`: ``{step, state, metadata}``."""
+    (n,) = struct.unpack_from("<q", blob, 0)
+    off = 8
+    skel = pickle.loads(blob[off:off + n])
+    off += n
+    leaves = []
+    for _ in range(skel["n_leaves"]):
+        (ln,) = struct.unpack_from("<q", blob, off)
+        off += 8
+        leaves.append(pickle.loads(blob[off:off + ln]))
+        off += ln
+    return {"step": skel["step"],
+            "state": _unflatten_tree(skel["treedef"], iter(leaves)),
+            "metadata": skel["metadata"]}
+
+
+def cut_shards(blob: bytes, n: int) -> tuple[int, list[bytes]]:
+    """Equal byte-range partition: ``(cut_size, shards)``.  Fewer than
+    ``n`` shards come back for blobs smaller than ``n`` bytes — empty
+    shards are never materialized, and ``n_shards(total, cut)`` is how
+    every holder derives the complete-set size."""
+    total = len(blob)
+    cut = max(1, -(-total // max(n, 1)))
+    return cut, [blob[i * cut:(i + 1) * cut]
+                 for i in range(n_shards(total, cut))]
+
+
+def n_shards(total_len: int, cut_size: int) -> int:
+    """Shard count implied by a (total, cut) pair — ceil(total/cut)."""
+    if cut_size <= 0:
+        return 0
+    return max(1, -(-total_len // cut_size))
+
+
+# -- store ------------------------------------------------------------------
+
+
+def _prune_locked() -> None:
+    steps = sorted({s for (s, _i) in _shards}, reverse=True)
+    for s in steps[2:]:
+        for key in [k for k in _shards if k[0] == s]:
+            del _shards[key]
+
+
+def absorb_remote_shard(*, owner: int, step: int, epoch: int,
+                        shard_index: int, cut_size: int, total_len: int,
+                        payload: bytes, via: str) -> bool:
+    """Land one shard in the store (called by drain's relay path and by
+    the data-plane receive thread).  A shard whose length disagrees with
+    its (index, cut, total) coordinates is torn — dropped, never stored:
+    assemble() must only ever concatenate consistent byte ranges."""
+    global _drained
+    if cut_size <= 0 or total_len < 0 or shard_index < 0:
+        return False
+    expect = max(0, min(cut_size, total_len - shard_index * cut_size))
+    if expect == 0 or len(payload) != expect:
+        return False
+    entry = ShardEntry(int(owner), int(step), int(epoch), int(shard_index),
+                       int(cut_size), int(total_len), payload, via)
+    with _lock:
+        cur = _shards.get((entry.step, entry.shard_index))
+        if cur is None or entry.epoch >= cur.epoch:
+            _shards[(entry.step, entry.shard_index)] = entry
+        if via != "local":
+            _drained += 1
+        _prune_locked()
+    return True
+
+
+def have_shards(step: int, epoch: int) -> list[int]:
+    """Sorted shard indices held locally for (step, epoch)."""
+    with _lock:
+        return sorted(i for (s, i), e in _shards.items()
+                      if s == step and e.epoch == epoch)
+
+
+# -- shipping ---------------------------------------------------------------
+
+
+def _acquire_ticket(eng, dst: int, step: int, nbytes: int,
+                    manifest: bytes) -> dict | None:
+    """Ticket round-trip: TICKET_REQ up to the coordinator, poll the
+    answering TICKET out of the engine inbox.  The wait is bounded by the
+    bulk timeout; tickets from earlier timed-out requests are discarded
+    (ships are sequential per process, so the match is (dst, step))."""
+    if not eng.ticket_request(dst, step, nbytes, manifest):
+        return None
+    deadline = time.monotonic() + env.bulk_timeout_ms() / 1000.0
+    while time.monotonic() < deadline:
+        t = eng.ticket_poll()
+        if t is not None:
+            if t["dst_rank"] == dst and t["step"] == step:
+                return t
+            continue  # stale ticket from an abandoned request: drop it
+        time.sleep(0.002)
+    return None
+
+
+def _ship_shard(eng, dst: int, step: int, shard_index: int, cut_size: int,
+                total_len: int, payload: bytes) -> str | None:
+    """One shard toward one peer, down the fallback chain: direct bulk
+    stream (ticketed) -> coordinator SHARD_PUT relay -> None (the caller's
+    disk copy is the last resort).  Returns the path taken."""
+    global _direct_shards, _direct_bytes, _relay_shards, _relay_bytes
+    from horovod_tpu import dataplane
+
+    if env.bulk_plane():
+        key = (eng.epoch, dst)
+        with _lock:
+            skip = key in _no_bulk
+        if not skip:
+            manifest = pickle.dumps(
+                {"shard": shard_index, "cut": cut_size, "total": total_len,
+                 "crc": zlib.crc32(payload)}, protocol=_PICKLE)
+            ticket = _acquire_ticket(eng, dst, step, len(payload), manifest)
+            if ticket is not None and ticket["dst_port"] <= 0:
+                with _lock:
+                    _no_bulk.add(key)
+            elif ticket is not None and dataplane.send(
+                    ticket, owner=eng.rank, shard_index=shard_index,
+                    cut_size=cut_size, total_len=total_len, payload=payload,
+                    rank=eng.rank):
+                with _lock:
+                    _direct_shards += 1
+                    _direct_bytes += len(payload)
+                eng.timeline_instant(
+                    "SHARD_STREAM",
+                    f"direct s{shard_index}->r{dst} {len(payload)}B")
+                return "direct"
+    wrapped = (_WRAP_MAGIC
+               + _WRAP_HDR.pack(shard_index, eng.rank, cut_size, total_len,
+                                zlib.crc32(payload))
+               + payload)
+    if eng.shard_put(dst, max(int(step), 0), wrapped):
+        with _lock:
+            _relay_shards += 1
+            _relay_bytes += len(payload)
+        eng.timeline_instant(
+            "SHARD_STREAM", f"relay s{shard_index}->r{dst} {len(payload)}B")
+        return "relay"
+    return None
 
 
 def put(step: int, state: Any, metadata: dict | None = None,
         eng: "core_engine.NativeEngine | None" = None) -> bool:
-    """Ship a snapshot to the neighbor's host memory.  Returns True when
-    the control plane accepted the frame (single-rank jobs and a dead
-    plane return False — the disk path still has the data)."""
+    """Shard a snapshot across the membership: keep shard ``rank``
+    locally, ship that one shard to the ring partner.  Returns True when
+    the shard reached a transport (direct or relay) or this rank had no
+    shard to ship (tiny blob); single-rank jobs and a dead plane return
+    False — the disk path still has the data."""
     global _puts
     eng = eng or core_engine.peek_engine()
     if eng is None or eng.size <= 1:
         return False
-    payload = pickle.dumps(
-        {"step": int(step), "state": state, "metadata": metadata},
-        protocol=pickle.HIGHEST_PROTOCOL)
-    ok = eng.shard_put(target_rank(eng.rank, eng.size), int(step), payload)
-    if ok:
+    blob = encode_snapshot(step, state, metadata)
+    cut, shards = cut_shards(blob, eng.size)
+    total = len(blob)
+    if eng.rank >= len(shards):
+        return True  # blob smaller than the membership: others cover it
+    mine = shards[eng.rank]
+    absorb_remote_shard(owner=eng.rank, step=int(step), epoch=eng.epoch,
+                        shard_index=eng.rank, cut_size=cut, total_len=total,
+                        payload=mine, via="local")
+    path = _ship_shard(eng, target_rank(eng.rank, eng.size), int(step),
+                       eng.rank, cut, total, mine)
+    if path is not None:
         with _lock:
             _puts += 1
-    return ok
+    return path is not None
 
 
 def drain(eng: "core_engine.NativeEngine | None" = None) -> int:
-    """Pull every shard waiting in the native inbox into the store (newest
-    step per owner wins) and fold in acks.  Returns shards absorbed."""
-    global _last_acked_step, _drained
+    """Pull everything waiting in the native shard inbox into this module
+    — relayed shards into the store, inventory views into the agreement
+    table — and fold in acks.  Returns shards absorbed.  (Direct-stream
+    shards bypass this path: the data-plane receive thread lands them in
+    the store the moment they pass CRC.)"""
+    global _last_acked_step
     eng = eng or core_engine.peek_engine()
     if eng is None:
         return 0
@@ -111,88 +364,280 @@ def drain(eng: "core_engine.NativeEngine | None" = None) -> int:
             break
         owner, step, epoch, payload = item
         if payload.startswith(_VIEW_MAGIC):
+            try:
+                inv = pickle.loads(payload[len(_VIEW_MAGIC):])
+            except Exception:
+                continue  # torn view: the sender will look empty, disk wins
             with _lock:
-                _views[owner] = (int(payload[len(_VIEW_MAGIC):]), epoch)
+                _inventories[owner] = (inv, epoch)
             continue
-        with _lock:
-            cur = _replicas.get(owner)
-            if cur is None or step >= cur.step:
-                _replicas[owner] = ReplicaEntry(owner, step, epoch, payload)
-            _drained += 1
-        count += 1
+        if payload.startswith(_WRAP_MAGIC):
+            off = len(_WRAP_MAGIC)
+            try:
+                shard_index, _src, cut, total, crc = _WRAP_HDR.unpack_from(
+                    payload, off)
+            except struct.error:
+                continue
+            body = payload[off + _WRAP_HDR.size:]
+            if zlib.crc32(body) != crc:
+                continue  # torn relay shard: drop, never store
+            if absorb_remote_shard(owner=owner, step=step, epoch=epoch,
+                                   shard_index=shard_index, cut_size=cut,
+                                   total_len=total, payload=body,
+                                   via="relay"):
+                count += 1
+            continue
+        # Unknown payload (pre-shard sender, fuzz): ignore rather than
+        # guess at a decode.
     for _owner, _tgt, step, _epoch in eng.shard_acks():
         with _lock:
             _last_acked_step = max(_last_acked_step, step)
     return count
 
 
-def send_view(replica_step: int,
-              eng: "core_engine.NativeEngine | None" = None) -> None:
-    """Announce this rank's best epoch-valid replica step to every peer.
+# -- restore agreement ------------------------------------------------------
 
-    Part of the restore agreement (checkpoint._restore_from_peers): after
-    a reconfiguration the survivors' local replica views legitimately
-    differ, and each must learn everyone's before they can pick ONE
-    restore step together.  The step also travels in the payload text —
-    the frame's step field is clamped non-negative for the wire."""
+
+def local_inventory(epoch: int) -> dict:
+    """``{step: {"cut": c, "total": t, "shards": [indices]}}`` for every
+    epoch-valid entry held locally."""
+    with _lock:
+        inv: dict = {}
+        for (step, idx), e in _shards.items():
+            if e.epoch != epoch:
+                continue
+            d = inv.setdefault(step, {"cut": e.cut_size,
+                                      "total": e.total_len, "shards": []})
+            if d["cut"] == e.cut_size and d["total"] == e.total_len:
+                d["shards"].append(idx)
+        for d in inv.values():
+            d["shards"].sort()
+        return inv
+
+
+def send_inventory(eng: "core_engine.NativeEngine | None" = None) -> dict:
+    """Broadcast this rank's inventory view to every peer and PIN it as
+    this rank's own announced view — election must run on what was
+    announced, not on a store that kept absorbing in-flight shards, or
+    ranks would elect from different worldviews."""
     eng = eng or core_engine.peek_engine()
     if eng is None or eng.size <= 1:
-        return
-    payload = _VIEW_MAGIC + str(int(replica_step)).encode()
+        return {}
+    inv = local_inventory(eng.epoch)
+    with _lock:
+        _inventories[eng.rank] = (inv, eng.epoch)
+    payload = _VIEW_MAGIC + pickle.dumps(inv, protocol=_PICKLE)
+    tag = max((int(s) for s in inv), default=0)
     for r in range(eng.size):
         if r != eng.rank:
-            eng.shard_put(r, max(int(replica_step), 0), payload)
+            eng.shard_put(r, max(tag, 0), payload)
+    return inv
 
 
-def views(epoch: int) -> dict[int, int]:
-    """Per-owner replica-step announcements stamped with *this* epoch
-    (stale-epoch views are invisible, like stale replicas)."""
+def inventories(epoch: int) -> dict[int, dict]:
+    """Per-rank announced inventories stamped with *this* epoch (stale-
+    epoch views are invisible, like stale shards)."""
     with _lock:
-        return {o: s for o, (s, e) in _views.items() if e == epoch}
+        return {r: inv for r, (inv, e) in _inventories.items() if e == epoch}
 
 
-def best(epoch: int) -> ReplicaEntry | None:
-    """Newest entry stamped with *this* membership epoch, or None.  Stale
-    epochs are rejected — the caller falls back to disk."""
+def elect(invs: dict[int, dict]) -> dict | None:
+    """The restore verdict: the newest step whose shard set is COMPLETE
+    across the union of announced inventories, with per-shard holder
+    lists.  Pure function of the inventories — every rank that exchanged
+    the same views computes the same verdict.  None: no complete set
+    survives, fall back to disk."""
+    candidates: dict[tuple[int, int, int], dict[int, list[int]]] = {}
+    for r, inv in invs.items():
+        for step, d in inv.items():
+            try:
+                key = (int(step), int(d["cut"]), int(d["total"]))
+                shards = [int(i) for i in d["shards"]]
+            except (KeyError, TypeError, ValueError):
+                continue  # malformed view: that rank contributes nothing
+            holders = candidates.setdefault(key, {})
+            for i in shards:
+                holders.setdefault(i, []).append(r)
+    best = None
+    for (step, cut, total), holders in candidates.items():
+        need = n_shards(total, cut)
+        if need == 0 or not all(i in holders for i in range(need)):
+            continue
+        if best is None or step > best["step"]:
+            best = {"step": step, "cut_size": cut, "total_len": total,
+                    "n_shards": need,
+                    "holders": {i: sorted(holders[i]) for i in range(need)}}
+    return best
+
+
+def ship_missing(election: dict,
+                 eng: "core_engine.NativeEngine | None" = None) -> int:
+    """Execute this rank's slice of the deterministic transfer plan: for
+    every shard whose LOWEST-rank announced holder is this rank, stream it
+    (direct -> relay) to each rank whose announced inventory lacks it.
+    Every rank derives the same plan from the same election + views, so
+    each transfer has exactly one sender."""
+    eng = eng or core_engine.peek_engine()
+    if eng is None:
+        return 0
+    invs = inventories(eng.epoch)
+    step, cut, total = (election["step"], election["cut_size"],
+                        election["total_len"])
+    shipped = 0
+    for i in range(election["n_shards"]):
+        holders = election["holders"].get(i, [])
+        if not holders or holders[0] != eng.rank:
+            continue
+        with _lock:
+            entry = _shards.get((step, i))
+        if entry is None or entry.cut_size != cut \
+                or entry.total_len != total:
+            continue  # announced it but lost it: receivers fall to disk
+        for r in range(eng.size):
+            if r == eng.rank:
+                continue
+            rinv = invs.get(r, {}).get(step)
+            if rinv is not None and rinv.get("cut") == cut \
+                    and i in rinv.get("shards", []):
+                continue  # already holds it
+            if _ship_shard(eng, r, step, i, cut, total, entry.payload):
+                shipped += 1
+    return shipped
+
+
+def assemble(election: dict, epoch: int) -> bytes | None:
+    """Reassemble the elected step's byte ranges from the local store;
+    None while any shard is missing or inconsistent (the caller keeps
+    draining until the deadline, then falls to disk — a torn set is never
+    decoded)."""
+    step, cut, total = (election["step"], election["cut_size"],
+                        election["total_len"])
+    parts = []
     with _lock:
-        live = [e for e in _replicas.values() if e.epoch == epoch]
-    return max(live, key=lambda e: e.step) if live else None
+        for i in range(election["n_shards"]):
+            e = _shards.get((step, i))
+            if e is None or e.epoch != epoch or e.cut_size != cut \
+                    or e.total_len != total:
+                return None
+            parts.append(e.payload)
+    blob = b"".join(parts)
+    return blob if len(blob) == total else None
 
 
-def decode(entry: ReplicaEntry) -> dict:
-    """Unpickle a replica payload back into {step, state, metadata}."""
-    return pickle.loads(entry.payload)
+def restore_local(epoch: int) -> dict | None:
+    """Uncoordinated restore from the LOCAL store only (broadcast=False
+    managers): newest locally-complete step, decoded; None otherwise.
+    At N=2 every rank holds both shards (its own + the partner's), so
+    this needs no transfers at all."""
+    election = elect({-1: local_inventory(epoch)})
+    if election is None:
+        return None
+    blob = assemble(election, epoch)
+    return decode_snapshot(blob) if blob is not None else None
+
+
+# -- membership changes -----------------------------------------------------
 
 
 def bump_epoch(new_epoch: int) -> None:
-    """Re-stamp every held entry to the new membership epoch.  Called by
+    """Re-stamp every held shard to the new membership epoch.  Called by
     elastic.reconfigure on ranks that PARTICIPATED in the reconfiguration:
-    their replicas describe state the new membership agrees on.  Ranks
-    that missed the reconfig never call this, so their stale stamps are
-    rejected by ``best`` and they restore from disk."""
+    their shards describe state the new membership agrees on.  Ranks that
+    missed the reconfig never call this, so their stale stamps are
+    invisible to election and they restore from disk."""
     with _lock:
-        for owner, e in list(_replicas.items()):
-            _replicas[owner] = e._replace(epoch=int(new_epoch))
+        for key, e in list(_shards.items()):
+            _shards[key] = e._replace(epoch=int(new_epoch))
+
+
+def reshard(eng: "core_engine.NativeEngine | None" = None) -> int:
+    """Post-RECONFIG shard shuffle: every survivor re-ships its held
+    shards of the newest step to its NEW ring partner, restoring the
+    two-holders-per-shard redundancy under the new membership.  Best
+    effort — a failed ship leaves disk as the fallback, exactly like a
+    failed put."""
+    eng = eng or core_engine.peek_engine()
+    if eng is None or eng.size <= 1:
+        return 0
+    with _lock:
+        steps = sorted({s for (s, _i) in _shards}, reverse=True)
+        if not steps:
+            return 0
+        newest = steps[0]
+        mine = [e for (s, _i), e in sorted(_shards.items())
+                if s == newest and e.epoch == eng.epoch]
+    dst = target_rank(eng.rank, eng.size)
+    count = 0
+    for e in mine:
+        if _ship_shard(eng, dst, e.step, e.shard_index, e.cut_size,
+                       e.total_len, e.payload):
+            count += 1
+    return count
 
 
 def clear() -> None:
-    global _last_acked_step, _puts, _drained
+    global _last_acked_step, _puts, _drained, _direct_shards, _relay_shards
+    global _direct_bytes, _relay_bytes, _disk_restores
     with _lock:
-        _replicas.clear()
-        _views.clear()
+        _shards.clear()
+        _inventories.clear()
+        _no_bulk.clear()
         _last_acked_step = -1
         _puts = 0
         _drained = 0
+        _direct_shards = 0
+        _relay_shards = 0
+        _direct_bytes = 0
+        _relay_bytes = 0
+        _disk_restores = 0
+
+
+def note_disk_restore() -> None:
+    """Checkpoint marks a peer-restore attempt that fell through to disk
+    — the tail of the fallback chain, counted for replication_stats."""
+    global _disk_restores
+    with _lock:
+        _disk_restores += 1
+
+
+# -- observability ----------------------------------------------------------
 
 
 def stats() -> dict:
     with _lock:
+        steps_held = sorted({s for (s, _i) in _shards})
         return {
-            "replicas": len(_replicas),
-            "owners": sorted(_replicas),
-            "newest_step": max((e.step for e in _replicas.values()),
-                               default=-1),
+            "replicas": len(_shards),
+            "shards_held": len(_shards),
+            "steps_held": steps_held,
+            "newest_step": steps_held[-1] if steps_held else -1,
             "last_acked_step": _last_acked_step,
             "puts": _puts,
             "drained": _drained,
+        }
+
+
+def replication_stats() -> dict:
+    """Public observability (``hvd.replication_stats()``): bytes shipped
+    per path, shard counts, fallback-chain usage, and the measured direct-
+    stream bandwidth.  The zero-coordinator-bytes claim is asserted on
+    ``bytes_shipped_relay == 0`` in steady state (bench.py ``dataplane``
+    phase, tests/test_dataplane.py)."""
+    from horovod_tpu import dataplane
+
+    dp = dataplane.stats()
+    with _lock:
+        return {
+            "shards_held": len(_shards),
+            "shards_shipped_direct": _direct_shards,
+            "shards_shipped_relay": _relay_shards,
+            "bytes_shipped_direct": _direct_bytes,
+            "bytes_shipped_relay": _relay_bytes,
+            "bytes_received_direct": dp["bytes_received"],
+            "streams_received": dp["streams_received"],
+            "recv_rejects": dp["recv_rejects"],
+            "send_failures": dp["send_failures"],
+            "disk_restores": _disk_restores,
+            "bandwidth_bytes_per_s": dp["send_bandwidth_bytes_per_s"],
+            "last_stream_error": dp["last_error"],
         }

@@ -114,6 +114,25 @@ aliases; the TPU-specific defaults differ where the hardware does:
   (default 0 = unchecked): tooling and the checkpoint soak fail if the
   newest complete checkpoint ever lags the training step by more than this
   many steps.
+* ``HVD_TPU_BULK_PLANE`` — rank-to-rank bulk data plane (default ON): each
+  rank binds a second TCP listener whose port rides its HELLO; replica
+  shards stream peer-to-peer under coordinator-issued tickets instead of
+  relaying through the rank-0 star (dataplane.py,
+  docs/fault_tolerance.md "Bulk data plane").  ``0`` forces every shard
+  transfer onto the legacy SHARD_PUT relay.
+* ``HVD_TPU_BULK_CHUNK_BYTES`` — CRC32-framed chunk size on a bulk stream
+  (default 1 MiB).  Each chunk is independently checksummed so a corrupt
+  link is detected mid-transfer, not after megabytes of garbage land.
+* ``HVD_TPU_BULK_TIMEOUT_MS`` — per-socket-operation bound (default 5000)
+  on bulk connect/send/recv, so a partitioned peer aborts the transfer —
+  falling down the direct -> relay -> disk chain — instead of hanging it.
+* ``HVD_TPU_BULK_MAX_BYTES`` — hard ceiling (default 1 GiB) on a single
+  bulk stream's advertised total; an oversized header is rejected as a
+  structured error naming the peer and transfer id, never buffered.
+* ``HVD_TPU_FAULT_BULK_{DROP,CORRUPT,TRUNCATE}`` — data-plane chaos
+  injectors (faults.py): ``"<rank>[:<nth>]"`` makes rank <rank>'s <nth>
+  bulk send vanish, carry a flipped chunk CRC, or close mid-stream —
+  exercising the fallback chain deterministically.
 """
 
 from __future__ import annotations
@@ -374,6 +393,55 @@ def ckpt_staleness_steps() -> int:
         return max(0, int(raw)) if raw not in (None, "") else 0
     except ValueError:
         return 0
+
+
+DEFAULT_BULK_CHUNK_BYTES = 1 << 20
+DEFAULT_BULK_TIMEOUT_MS = 5000.0
+DEFAULT_BULK_MAX_BYTES = 1 << 30
+
+
+def bulk_plane() -> bool:
+    """``HVD_TPU_BULK_PLANE`` — the rank-to-rank bulk data plane (default
+    ON).  When on, replication shard payloads stream directly between peer
+    bulk listeners under coordinator-issued tickets; the coordinator star
+    carries only the control frames.  Off: every transfer takes the legacy
+    SHARD_PUT relay through rank 0."""
+    raw = _get("BULK_PLANE")
+    return raw is None or raw not in ("0", "false", "False")
+
+
+def bulk_chunk_bytes() -> int:
+    """``HVD_TPU_BULK_CHUNK_BYTES`` — bulk-stream chunk size (default
+    1 MiB); each chunk carries its own CRC32 so corruption is caught at
+    chunk granularity."""
+    raw = _get("BULK_CHUNK_BYTES")
+    try:
+        value = int(raw) if raw not in (None, "") else DEFAULT_BULK_CHUNK_BYTES
+    except ValueError:
+        return DEFAULT_BULK_CHUNK_BYTES
+    return max(4096, value)
+
+
+def bulk_timeout_ms() -> float:
+    """``HVD_TPU_BULK_TIMEOUT_MS`` — per-operation socket bound (default
+    5000) on the bulk plane: connect, each chunk send/recv, and the final
+    ack all share it, so a dead or partitioned peer becomes an abort-and-
+    fallback, never a hang."""
+    raw = _get("BULK_TIMEOUT_MS")
+    try:
+        return float(raw) if raw not in (None, "") else DEFAULT_BULK_TIMEOUT_MS
+    except ValueError:
+        return DEFAULT_BULK_TIMEOUT_MS
+
+
+def bulk_max_bytes() -> int:
+    """``HVD_TPU_BULK_MAX_BYTES`` — ceiling (default 1 GiB) on one bulk
+    stream's advertised payload; larger headers are structured errors."""
+    raw = _get("BULK_MAX_BYTES")
+    try:
+        return int(raw) if raw not in (None, "") else DEFAULT_BULK_MAX_BYTES
+    except ValueError:
+        return DEFAULT_BULK_MAX_BYTES
 
 
 def device_headroom_mb() -> float | None:

@@ -1,0 +1,404 @@
+"""Rank-to-rank bulk data plane (horovod_tpu/dataplane.py): ticketed peer
+streams for ZeRO-sharded replicas (docs/fault_tolerance.md "Bulk data
+plane").
+
+Three layers of coverage:
+
+* In-process receiver hardening — raw sockets drive the process-global
+  listener with bad magic, oversized advertisements, token mismatches,
+  corrupt chunks, and mid-stream sender death; every case must become a
+  structured CollectiveError naming the peer and transfer id (recorded in
+  ``dataplane.stats``), never a hang, never a torn shard in the store —
+  and the listener must keep serving afterwards.
+* Token parity — the Python mirror of core/src/message.cc BulkToken is
+  pinned bit-for-bit against the native ``hvd_bulk_token`` export, since
+  sender (C++ ticket) and receiver (Python listener) must agree.
+* Multi-process — two engine-only ranks replicate over a REAL control
+  plane: steady state ships every shard direct with ZERO payload bytes
+  through the coordinator star (the acceptance bar), and the chaos soak
+  (slow; DROP/CORRUPT/TRUNCATE/PARTITION via HVD_TPU_FAULT_BULK_* and a
+  dead listener) proves every failure lands on the relay leg of the
+  fallback chain with both ranks restoring bit-exact.
+"""
+
+import ctypes
+import os
+import random
+import socket
+import struct
+import subprocess
+import sys
+import textwrap
+import time
+import zlib
+
+import pytest
+
+from _timing import scaled
+
+from horovod_tpu import dataplane, replication
+from horovod_tpu.core import engine as core_engine
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+FAST_HB = {
+    "HVD_TPU_HEARTBEAT_MS": "50",
+    "HVD_TPU_HEARTBEAT_TIMEOUT_MS": str(int(scaled(800))),
+    "HVD_TPU_ABORT_GRACE_MS": "300",
+    "HVD_TPU_CONNECT_TIMEOUT": str(scaled(60)),
+}
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class _FakeEngine:
+    """rank/epoch duck type for receiver-side token validation."""
+
+    def __init__(self, rank=0, epoch=0):
+        self.rank, self.epoch = rank, epoch
+
+
+@pytest.fixture()
+def listener(monkeypatch):
+    """Process-global bulk listener + a fake rank-0 engine to validate
+    tokens against; stats reset around each test."""
+    port = dataplane.ensure_listener()
+    monkeypatch.setattr(core_engine, "peek_engine",
+                        lambda: _FakeEngine(rank=0, epoch=0))
+    dataplane.reset_stats()
+    replication.clear()
+    yield port
+    replication.clear()
+    dataplane.reset_stats()
+
+
+def _stream(port, payload, *, transfer_id=7, src=1, epoch=0, token=None,
+            owner=1, shard_index=0, step=3, cut=None, total=None,
+            nbytes=None, chunks=None, chunk_crc_xor=0, close_after=None):
+    """Hand-rolled sender: push one bulk stream at the listener and return
+    the ack byte(s) read back (b"" = rejected, connection closed)."""
+    cut = len(payload) if cut is None else cut
+    total = len(payload) if total is None else total
+    nbytes = len(payload) if nbytes is None else nbytes
+    if token is None:
+        token = dataplane._token(transfer_id, epoch, src, 0)
+    hdr = dataplane._HDR.pack(
+        dataplane._MAGIC, dataplane._VERSION, src, transfer_id, token,
+        owner, shard_index, step, epoch, cut, total, nbytes,
+        zlib.crc32(payload))
+    sock = socket.create_connection(("127.0.0.1", port), timeout=5)
+    try:
+        sock.settimeout(scaled(10))
+        sock.sendall(hdr)
+        sent = 0
+        for chunk in (chunks if chunks is not None else [payload]):
+            if close_after is not None and sent >= close_after:
+                return b""  # sender dies mid-transfer
+            crc = zlib.crc32(chunk) ^ chunk_crc_xor
+            sock.sendall(struct.pack("<II", len(chunk), crc) + chunk)
+            sent += len(chunk)
+        try:
+            return sock.recv(1)
+        except OSError:
+            return b""
+    finally:
+        sock.close()
+
+
+def _wait_reject(n=1, deadline_s=10):
+    deadline = time.monotonic() + scaled(deadline_s)
+    while time.monotonic() < deadline:
+        if dataplane.stats()["recv_rejects"] >= n:
+            return dataplane.stats()
+        time.sleep(0.01)
+    raise AssertionError(f"reject never recorded: {dataplane.stats()}")
+
+
+# ---------------------------------------------------------------------------
+# receiver hardening: every malformed stream -> structured error, no ack,
+# no torn shard, listener stays up
+# ---------------------------------------------------------------------------
+
+def test_good_stream_lands_shard_and_acks(listener):
+    ack = _stream(listener, b"x" * 1000, step=3)
+    assert ack == b"\x01"
+    assert replication.have_shards(3, 0) == [0]
+    s = dataplane.stats()
+    assert s["streams_received"] == 1 and s["recv_rejects"] == 0
+    assert s["bytes_received"] == 1000
+
+
+def test_bad_magic_rejected_with_structured_error(listener):
+    sock = socket.create_connection(("127.0.0.1", listener), timeout=5)
+    try:
+        sock.sendall(b"\x00" * dataplane._HDR.size)
+        assert sock.recv(1) == b""  # closed, never acked
+    finally:
+        sock.close()
+    s = _wait_reject()
+    assert "frame_desync" in s["last_error"], s["last_error"]
+    assert replication.have_shards(3, 0) == []
+
+
+def test_oversized_advertisement_rejected_before_payload(listener,
+                                                         monkeypatch):
+    monkeypatch.setenv("HVD_TPU_BULK_MAX_BYTES", "1024")
+    ack = _stream(listener, b"y" * 64, transfer_id=42, nbytes=1 << 20,
+                  total=1 << 20, chunks=[])
+    assert ack == b""
+    s = _wait_reject()
+    assert "transfer 42" in s["last_error"], s["last_error"]
+    assert "rank 1" in s["last_error"]
+    assert "HVD_TPU_BULK_MAX_BYTES" in s["last_error"]
+
+
+def test_token_mismatch_rejected_as_stale_or_misrouted(listener):
+    # A token minted for epoch 5 arrives at an epoch-0 receiver — the
+    # stale-epoch / misrouted-stream rejection, validated header-first.
+    ack = _stream(listener, b"z" * 128, transfer_id=9,
+                  token=dataplane._token(9, 5, 1, 0))
+    assert ack == b""
+    s = _wait_reject()
+    assert "transfer 9" in s["last_error"]
+    assert "stale_epoch" in s["last_error"], s["last_error"]
+    assert replication.have_shards(3, 0) == []
+
+
+def test_corrupt_chunk_crc_rejected_never_stored(listener):
+    ack = _stream(listener, b"c" * 512, transfer_id=11, chunk_crc_xor=1)
+    assert ack == b""
+    s = _wait_reject()
+    assert "transfer 11" in s["last_error"]
+    assert "frame_corrupt" in s["last_error"], s["last_error"]
+    assert replication.have_shards(3, 0) == []
+
+
+def test_sender_death_mid_transfer_aborts_transfer_not_listener(listener):
+    """Kill-mid-transfer: the sender vanishes after half the payload.  The
+    receiver must record a structured connection_lost naming the transfer,
+    store nothing, and keep serving — the very next stream lands."""
+    payload = b"k" * 4096
+    half = [payload[:2048], payload[2048:]]
+    ack = _stream(listener, payload, transfer_id=13, chunks=half,
+                  close_after=2048)
+    assert ack == b""
+    s = _wait_reject()
+    assert "transfer 13" in s["last_error"]
+    assert "connection_lost" in s["last_error"], s["last_error"]
+    assert replication.have_shards(3, 0) == []
+    assert _stream(listener, b"ok" * 100, transfer_id=14) == b"\x01"
+    assert replication.have_shards(3, 0) == [0]
+
+
+def test_shard_disagreeing_with_coordinates_rejected(listener):
+    # 10 payload bytes claiming to be shard 0 of cut=4,total=8: torn.
+    ack = _stream(listener, b"t" * 10, transfer_id=15, cut=4, total=8)
+    assert ack == b""
+    s = _wait_reject()
+    assert "torn" in s["last_error"], s["last_error"]
+    assert replication.have_shards(3, 0) == []
+
+
+# ---------------------------------------------------------------------------
+# token parity with the native engine
+# ---------------------------------------------------------------------------
+
+def test_bulk_token_matches_native_bit_for_bit():
+    lib = core_engine.lib()
+    lib.hvd_bulk_token.restype = ctypes.c_uint64
+    lib.hvd_bulk_token.argtypes = [ctypes.c_longlong, ctypes.c_longlong,
+                                   ctypes.c_int, ctypes.c_int]
+    rng = random.Random(20260805)
+    for _ in range(500):
+        tid = rng.randrange(0, 1 << 62)
+        epoch = rng.randrange(0, 1 << 30)
+        src, dst = rng.randrange(0, 4096), rng.randrange(0, 4096)
+        assert dataplane._token(tid, epoch, src, dst) == \
+            lib.hvd_bulk_token(tid, epoch, src, dst), (tid, epoch, src, dst)
+
+
+# ---------------------------------------------------------------------------
+# per-rank replication bytes scale ~1/N (the ZeRO point of the sharding)
+# ---------------------------------------------------------------------------
+
+class _RelayEngine:
+    def __init__(self, rank, size, epoch=0):
+        self.rank, self.size, self.epoch = rank, size, epoch
+
+    def shard_put(self, target_rank, step, payload):
+        return True
+
+    def shard_acks(self):
+        return []
+
+    def ticket_request(self, dst, step, nbytes, manifest=b""):
+        return False
+
+    def timeline_instant(self, name, args=""):
+        pass
+
+
+def test_replication_bytes_per_rank_scale_inverse_with_size():
+    import numpy as np
+    state = {"w": np.arange(100000, dtype=np.float32)}
+
+    def shipped(n):
+        replication.clear()
+        replication.put(3, state, eng=_RelayEngine(rank=0, size=n))
+        return replication.replication_stats()["bytes_shipped_relay"]
+
+    try:
+        b2, b4 = shipped(2), shipped(4)
+    finally:
+        replication.clear()
+    assert b2 > 0 and b4 > 0
+    assert 0.4 <= b4 / b2 <= 0.6, (b2, b4)  # ~1/2 when N doubles
+
+
+# ---------------------------------------------------------------------------
+# multi-process: real control plane, real tickets, real streams
+# ---------------------------------------------------------------------------
+
+# argv = [rank, coordinator_port, size].  Engine-only 2-rank job: binds the
+# bulk listener, replicates DP_STEPS sharded snapshots of an identical
+# state, waits until the newest step restores locally, prints the restore
+# checksum + replication_stats.  DP_MODE=PARTITION closes this rank's bulk
+# listener after the port was advertised (direct connects to it then die).
+DP_WORKER = textwrap.dedent("""
+    import hashlib, os, sys, time
+    import numpy as np
+    from horovod_tpu import dataplane, replication
+    from horovod_tpu.core import engine as ce
+    from horovod_tpu.core.engine import NativeEngine
+    from horovod_tpu.core.executors import local_executor
+
+    rank, port, n = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    steps = int(os.environ.get("DP_STEPS", "3"))
+    mode = os.environ.get("DP_MODE", "")
+    bp = dataplane.ensure_listener()
+    eng = NativeEngine(rank, n, executor=local_executor,
+                       coordinator_host="127.0.0.1", coordinator_port=port,
+                       cycle_time_ms=2.0, bulk_port=bp)
+    ce.replace_engine(None, eng)
+    if mode == "PARTITION" and rank == 1:
+        dataplane.shutdown()  # advertised endpoint goes dark
+    state = {"w": np.arange(200000, dtype=np.float32) * 0.5,
+             "b": np.full(64, 7.0, np.float64)}
+    for step in range(1, steps + 1):
+        replication.put(step, state, {"r": "same"}, eng=eng)
+    doc = None
+    deadline = time.time() + float(os.environ.get("DP_WAIT_S", "30"))
+    while time.time() < deadline:
+        replication.drain(eng)
+        doc = replication.restore_local(eng.epoch)
+        if doc is not None and doc["step"] == steps:
+            break
+        time.sleep(0.02)
+    if doc is None or doc["step"] != steps:
+        print(f"RANK{rank} RESTORE=FAILED", flush=True)
+    else:
+        h = hashlib.sha256(doc["state"]["w"].tobytes()
+                           + doc["state"]["b"].tobytes()).hexdigest()[:16]
+        print(f"RANK{rank} RESTORE={doc['step']}:{h}", flush=True)
+    print(f"RANK{rank} STATS={replication.replication_stats()!r}",
+          flush=True)
+    time.sleep(0.5)  # let the partner's last acks land before teardown
+    eng.shutdown()
+    print(f"RANK{rank} DONE", flush=True)
+""")
+
+
+def _spawn_dp(extra_env, nprocs=2):
+    port = _free_port()
+    env = {**os.environ, "PYTHONPATH": REPO, **FAST_HB, **extra_env,
+           "DP_WAIT_S": str(scaled(30))}
+    return [
+        subprocess.Popen(
+            [sys.executable, "-c", DP_WORKER, str(r), str(port), str(nprocs)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=REPO)
+        for r in range(nprocs)
+    ]
+
+
+def _drain(procs, timeout):
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out or "")
+    return outs
+
+
+def _field(out, key):
+    for line in out.splitlines():
+        if key in line:
+            return line.split(key, 1)[1]
+    raise AssertionError(f"{key} missing:\n{out[-2000:]}")
+
+
+def test_steady_state_ships_direct_with_zero_coordinator_payload_bytes():
+    """The acceptance bar: with both endpoints advertised, every replica
+    shard moves rank-to-rank — replication_stats shows zero bytes on the
+    coordinator relay, and both ranks reassemble the same snapshot."""
+    procs = _spawn_dp({})
+    outs = _drain(procs, timeout=scaled(90))
+    restores = []
+    for r, out in enumerate(outs):
+        assert procs[r].returncode == 0, (procs[r].returncode, out[-2000:])
+        assert f"RANK{r} DONE" in out, out[-2000:]
+        restores.append(_field(out, f"RANK{r} RESTORE="))
+        stats = eval(_field(out, f"RANK{r} STATS="))  # repr'd plain dict
+        assert stats["shards_shipped_direct"] == 3, stats
+        assert stats["shards_shipped_relay"] == 0, stats
+        assert stats["bytes_shipped_relay"] == 0, stats
+        assert stats["streams_received"] == 3, stats
+        assert stats["recv_rejects"] == 0, stats
+        assert stats["bytes_shipped_direct"] > 0
+        assert stats["bandwidth_bytes_per_s"] > 0
+    assert "FAILED" not in restores[0]
+    assert restores[0] == restores[1], restores  # bit-exact reassembly
+
+
+# Chaos soak: every injected data-plane failure must degrade down the
+# fallback chain (direct -> relay) with BOTH ranks still reassembling the
+# identical snapshot — never a hang, never a torn set.  Sender-side faults
+# break rank 1's second outgoing stream (HVD_TPU_FAULT_BULK_*); PARTITION
+# darkens rank 1's advertised listener so rank 0's connects die.
+@pytest.mark.slow
+@pytest.mark.parametrize("mode", ["DROP", "CORRUPT", "TRUNCATE",
+                                  "PARTITION"])
+def test_chaos_soak_faults_land_on_fallback_chain_bit_exact(mode):
+    reps = int(os.environ.get("HVD_TPU_SOAK_REPS", "1"))
+    for rep in range(reps):
+        extra = {"DP_MODE": mode}
+        if mode != "PARTITION":
+            extra[f"HVD_TPU_FAULT_BULK_{mode}"] = f"1:{1 + rep % 2}"
+        procs = _spawn_dp(extra)
+        outs = _drain(procs, timeout=scaled(90))
+        restores, stats = [], []
+        for r, out in enumerate(outs):
+            assert procs[r].returncode == 0, \
+                (mode, rep, procs[r].returncode, out[-2000:])
+            restores.append(_field(out, f"RANK{r} RESTORE="))
+            stats.append(eval(_field(out, f"RANK{r} STATS=")))
+        assert "FAILED" not in restores[0], (mode, rep, restores)
+        assert restores[0] == restores[1], (mode, rep, restores)
+        faulted = 0 if mode == "PARTITION" else 1  # who had to fall back
+        assert stats[faulted]["shards_shipped_relay"] >= 1, \
+            (mode, rep, stats[faulted])
+        if mode in ("CORRUPT", "TRUNCATE"):
+            # The victim saw the broken stream and rejected it cleanly.
+            assert stats[1 - faulted]["recv_rejects"] >= 1 \
+                or stats[1 - faulted]["last_stream_error"], \
+                (mode, rep, stats[1 - faulted])

@@ -265,6 +265,67 @@ def test_truncated_frame_structured_error():
     assert "'failed_rank': 1" in out0, out0[-2000:]
 
 
+@pytest.mark.parametrize("shape", ["oversized", "truncated"])
+def test_malformed_shard_payload_structured_error_never_desyncs(shape):
+    """Bulk-replica wire hardening on the CONTROL plane: a SHARD_PUT frame
+    whose header advertises more bytes than the 64 MiB frame cap, or whose
+    payload is cut off mid-frame, must produce a structured abort naming
+    the offending rank — never a desynced stream, a garbage deserialize,
+    or a hang.  (The rank-to-rank bulk stream equivalents live in
+    tests/test_dataplane.py; this drives the legacy relay leg.)"""
+    port = _free_port()
+    env = {**os.environ, "PYTHONPATH": REPO, **FAST_HB}
+    p0 = subprocess.Popen(
+        [sys.executable, "-c", WORKER, "0", str(port), "2"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=REPO)
+
+    def frame(ftype, payload):
+        return struct.pack("<IBBHII", 0x48564446, 1, ftype, 0,
+                           len(payload), zlib.crc32(payload)) + payload
+
+    peer = None
+    deadline = time.monotonic() + scaled(60)
+    while peer is None:
+        try:
+            peer = socket.create_connection(("127.0.0.1", port), timeout=5)
+        except OSError:
+            assert time.monotonic() < deadline, "coordinator never listened"
+            time.sleep(0.1)
+    try:
+        peer.sendall(frame(1, struct.pack("<ii", 1, 0)))  # HELLO rank 1
+        ack = peer.recv(16)
+        assert len(ack) == 16 and ack[:4] == b"FDVH", ack
+        if shape == "oversized":
+            # SHARD_PUT (type 12) header advertising 65 MiB — past the
+            # kMaxFrameBytes sanity cap; no payload need follow.
+            peer.sendall(struct.pack("<IBBHII", 0x48564446, 1, 12, 0,
+                                     65 << 20, 0))
+        else:
+            # SHARD_PUT header promising 4096 payload bytes; deliver a
+            # fragment of a plausible shard body, then die mid-frame.
+            peer.sendall(struct.pack("<IBBHII", 0x48564446, 1, 12, 0,
+                                     4096, zlib.crc32(b"s" * 4096)))
+            peer.sendall(b"s" * 100)
+        peer.shutdown(socket.SHUT_WR)  # FIN, not RST (see test above)
+        peer.settimeout(scaled(20))
+        try:
+            while peer.recv(4096):
+                pass
+        except OSError:
+            pass
+    finally:
+        peer.close()
+    out0 = _drain([p0], timeout=scaled(40))[0]
+    assert p0.returncode == 75, (p0.returncode, out0[-2000:])
+    assert "'failed_rank': 1" in out0, out0[-2000:]
+    if shape == "oversized":
+        assert "'cause': 'frame_corrupt'" in out0, out0[-2000:]
+        assert "absurd frame length" in out0, out0[-2000:]
+    else:
+        assert "truncated mid-frame" in out0, out0[-2000:]
+
+
 def test_version_skew_rejected_at_connect():
     """Mixed-build protection: a worker advertising a different protocol
     version is rejected at the HELLO handshake with a structured error on

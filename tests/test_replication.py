@@ -1,20 +1,19 @@
-"""Peer-replicated checkpoint store (horovod_tpu/replication.py) and the
+"""ZeRO-sharded peer replica store (horovod_tpu/replication.py) and the
 CheckpointManager peer-restore path (docs/fault_tolerance.md "Async &
 peer-replicated checkpointing").
 
-The store tests use a duck-typed engine (the NativeEngine shard API is
-three methods plus rank/size/epoch) so the epoch-invalidation semantics
-are pinned without a control plane; the manager tests monkeypatch
-``peek_engine`` the same way and assert the acceptance bar directly:
-peer restore performs ZERO payload reads from disk
-(``checkpoint.disk_read_count``), round-trips bit-exact, and an
-epoch-stale replica is rejected with a clean disk fallback.  End-to-end
-frames over a real control plane are covered by the elastic rejoin test
-in tests/test_elastic_reconfig.py and the shard soak in
-tests/test_failure_detection.py.
+The store tests use a duck-typed engine (the NativeEngine shard/ticket API
+is a handful of methods plus rank/size/epoch) so the sharding, election,
+and epoch-invalidation semantics are pinned without a control plane; the
+FakeEngine refuses tickets, so every ship exercises the relay leg of the
+fallback chain.  The manager tests monkeypatch ``peek_engine`` the same
+way and assert the acceptance bar directly: peer restore performs ZERO
+payload reads from disk (``checkpoint.disk_read_count``), round-trips
+bit-exact, and an epoch-stale shard set is rejected with a clean disk
+fallback.  End-to-end frames over a real control plane and the direct
+bulk-stream leg are covered in tests/test_dataplane.py and the elastic
+rejoin tests in tests/test_elastic_reconfig.py.
 """
-
-import pickle
 
 import numpy as np
 import pytest
@@ -26,7 +25,8 @@ class FakeEngine:
     """NativeEngine shard-API duck type: shard_put stamps this engine's
     epoch (exactly what core/src/engine.cc ShardPutSend does) and loops
     the frame into ``inbox`` so drain() on the same object plays the
-    RECEIVING rank."""
+    RECEIVING rank.  ticket_request always refuses, so shipping falls
+    straight down the chain to the coordinator relay."""
 
     def __init__(self, rank=0, size=2, epoch=0):
         self.rank, self.size, self.epoch = rank, size, epoch
@@ -47,6 +47,18 @@ class FakeEngine:
         out, self.acks = self.acks, []
         return out
 
+    def ticket_request(self, dst, step, nbytes, manifest=b""):
+        return False  # no bulk plane in the duck type: relay leg only
+
+    def ticket_poll(self):
+        return None
+
+    def timeline_instant(self, name, args=""):
+        pass
+
+    def resize_event(self):
+        return None
+
 
 @pytest.fixture(autouse=True)
 def _clean_store():
@@ -55,9 +67,21 @@ def _clean_store():
     replication.clear()
 
 
-def _entry(owner, step, epoch, state):
-    payload = pickle.dumps({"step": step, "state": state, "metadata": {}})
-    return replication.ReplicaEntry(owner, step, epoch, payload)
+def _np_state(v: float):
+    return {"w": np.full(4, v, np.float32), "step_arr": np.array(int(v)),
+            "opt": [np.arange(3.0), (1, 2.5)]}
+
+
+def _seed_full_set(step, epoch, state, n=2, metadata=None):
+    """Cut a snapshot into n shards and land ALL of them locally —
+    the worldview of a rank whose partners finished replicating."""
+    blob = replication.encode_snapshot(step, state, metadata)
+    cut, shards = replication.cut_shards(blob, n)
+    for i, sh in enumerate(shards):
+        assert replication.absorb_remote_shard(
+            owner=i % n, step=step, epoch=epoch, shard_index=i,
+            cut_size=cut, total_len=len(blob), payload=sh, via="local")
+    return blob
 
 
 def test_target_rank_is_ring_neighbor():
@@ -66,70 +90,176 @@ def test_target_rank_is_ring_neighbor():
     assert replication.target_rank(0, 1) == 0
 
 
-def test_put_ships_to_neighbor_and_drain_absorbs():
+# ---------------------------------------------------------------------------
+# snapshot codec + byte-range sharding
+# ---------------------------------------------------------------------------
+
+def test_codec_round_trips_nested_trees_bit_exact():
+    state = {"a": np.arange(7, dtype=np.int64),
+             "nest": {"w": np.full((2, 3), 1.5, np.float32)},
+             "seq": [np.array(2.0), (np.arange(2), "tag")],
+             "scalar": 3}
+    blob = replication.encode_snapshot(11, state, {"rng": [1, 2]})
+    doc = replication.decode_snapshot(blob)
+    assert doc["step"] == 11 and doc["metadata"] == {"rng": [1, 2]}
+    out = doc["state"]
+    np.testing.assert_array_equal(out["a"], state["a"])
+    np.testing.assert_array_equal(out["nest"]["w"], state["nest"]["w"])
+    np.testing.assert_array_equal(out["seq"][1][0], np.arange(2))
+    assert out["seq"][1][1] == "tag" and out["scalar"] == 3
+    assert isinstance(out["seq"], list) and isinstance(out["seq"][1], tuple)
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 4, 7])
+def test_cut_shards_partitions_exactly(n):
+    blob = bytes(range(256)) * 13  # 3328 bytes, not divisible by most n
+    cut, shards = replication.cut_shards(blob, n)
+    assert b"".join(shards) == blob
+    assert cut == -(-len(blob) // n)
+    assert len(shards) == replication.n_shards(len(blob), cut)
+    assert all(len(s) == cut for s in shards[:-1])
+    assert 0 < len(shards[-1]) <= cut
+
+
+def test_cut_shards_tiny_blob_never_materializes_empty_shards():
+    cut, shards = replication.cut_shards(b"ab", 4)
+    assert cut == 1 and shards == [b"a", b"b"]
+    assert replication.n_shards(2, cut) == 2
+
+
+# ---------------------------------------------------------------------------
+# store: put/drain/absorb semantics
+# ---------------------------------------------------------------------------
+
+def test_put_keeps_own_shard_and_relays_it_to_ring_neighbor():
     eng = FakeEngine(rank=1, size=3, epoch=0)
-    state = {"w": np.arange(4.0)}
-    assert replication.put(7, state, {"rng": [1, 2]}, eng=eng)
+    assert replication.put(7, _np_state(1.0), {"rng": [1]}, eng=eng)
     assert eng.sent[0][0] == 2  # ring neighbor of rank 1
-    assert replication.drain(eng) == 1
-    entry = replication.best(epoch=0)
-    assert entry is not None and entry.step == 7 and entry.owner_rank == 1
-    doc = replication.decode(entry)
-    np.testing.assert_array_equal(doc["state"]["w"], np.arange(4.0))
-    assert doc["metadata"] == {"rng": [1, 2]}
-    assert replication.stats()["last_acked_step"] == 7
+    assert replication.have_shards(7, 0) == [1]  # kept shard index == rank
+    assert replication.drain(eng) == 1  # loopback relay absorbs as well
+    s = replication.stats()
+    assert s["puts"] == 1 and s["drained"] == 1
+    assert s["last_acked_step"] == 7
+    rs = replication.replication_stats()
+    assert rs["shards_shipped_relay"] == 1
+    assert rs["shards_shipped_direct"] == 0
 
 
 def test_put_refuses_single_rank_jobs():
     assert not replication.put(1, {"w": 0}, eng=FakeEngine(rank=0, size=1))
-    assert replication.best(epoch=0) is None
+    assert replication.have_shards(1, 0) == []
 
 
-def test_newest_step_per_owner_wins():
-    eng = FakeEngine(rank=0, size=2)
-    for s in (3, 9, 5):  # out-of-order arrival: 9 must survive
-        replication.put(s, {"s": s}, eng=eng)
-    replication.drain(eng)
-    assert replication.best(epoch=0).step == 9
-    assert replication.stats()["replicas"] == 1  # one slot per owner
+def test_absorb_rejects_torn_shards():
+    ok = replication.absorb_remote_shard(
+        owner=0, step=3, epoch=0, shard_index=0, cut_size=4, total_len=8,
+        payload=b"abc", via="relay")  # expect 4 bytes, got 3: torn
+    assert not ok
+    assert replication.absorb_remote_shard(
+        owner=0, step=3, epoch=0, shard_index=2, cut_size=4, total_len=8,
+        payload=b"", via="relay") is False  # index beyond the blob
+    assert replication.have_shards(3, 0) == []
 
 
-def test_best_rejects_stale_epoch_and_bump_revalidates():
+def test_store_prunes_to_two_newest_steps():
+    for step in (3, 9, 5):  # out-of-order arrival
+        _seed_full_set(step, 0, _np_state(float(step)))
+    steps = sorted({s for (s, _i) in replication._shards})
+    assert steps == [5, 9]  # 3 pruned, newest-incomplete insurance kept
+
+
+def test_drain_ignores_unknown_and_torn_relay_payloads():
     eng = FakeEngine(rank=0, size=2, epoch=0)
-    replication.put(4, {"s": 4}, eng=eng)
-    replication.drain(eng)
-    # The membership moved on without this entry being re-stamped: a
-    # restore at epoch 1 must NOT see the epoch-0 replica.
-    assert replication.best(epoch=1) is None
-    assert replication.best(epoch=0) is not None
-    # A rank that PARTICIPATED in the reconfig re-stamps its survivors.
+    eng.inbox.append((1, 5, 0, b"garbage-from-the-past"))
+    wrapped = (replication._WRAP_MAGIC
+               + replication._WRAP_HDR.pack(0, 1, 4, 8, 0xDEADBEEF)
+               + b"abcd")  # CRC mismatch
+    eng.inbox.append((1, 5, 0, wrapped))
+    assert replication.drain(eng) == 0
+    assert replication.have_shards(5, 0) == []
+
+
+# ---------------------------------------------------------------------------
+# election + epoch invalidation
+# ---------------------------------------------------------------------------
+
+def test_elect_needs_complete_set_across_union():
+    blob = _seed_full_set(6, 0, _np_state(6.0), n=3)
+    cut = -(-len(blob) // 3)
+    full = replication.local_inventory(0)
+    # Split the inventory across two fake ranks: neither is complete
+    # alone, together they cover all three shards.
+    a = {6: {"cut": cut, "total": len(blob), "shards": [0, 1]}}
+    b = {6: {"cut": cut, "total": len(blob), "shards": [2]}}
+    el = replication.elect({0: a, 1: b})
+    assert el is not None and el["step"] == 6 and el["n_shards"] == 3
+    assert el["holders"][2] == [1]
+    # Drop shard 2 everywhere: no complete set, no verdict.
+    assert replication.elect({0: a}) is None
+    # Sanity: the locally-held set elects too.
+    assert replication.elect({-1: full})["step"] == 6
+
+
+def test_elect_prefers_newest_complete_step_and_skips_malformed():
+    inv = {4: {"cut": 2, "total": 4, "shards": [0, 1]},
+           9: {"cut": 2, "total": 4, "shards": [0]},  # incomplete
+           7: {"cut": 2, "total": 4, "shards": [0, 1]},
+           "bad": "not-a-dict"}
+    el = replication.elect({0: inv})
+    assert el["step"] == 7  # 9 is torn, 7 beats 4
+
+
+def test_epoch_stale_shards_invisible_until_bump():
+    _seed_full_set(4, 0, _np_state(4.0))
+    assert replication.restore_local(1) is None  # membership moved on
+    assert replication.restore_local(0)["step"] == 4
+    replication.bump_epoch(1)  # this rank PARTICIPATED in the reconfig
+    assert replication.restore_local(1)["step"] == 4
+    assert replication.restore_local(0) is None
+
+
+def test_restore_local_round_trips_bit_exact():
+    state = _np_state(5.0)
+    _seed_full_set(5, 2, state, metadata={"rng": [9]})
+    doc = replication.restore_local(2)
+    assert doc is not None and doc["step"] == 5
+    np.testing.assert_array_equal(doc["state"]["w"], state["w"])
+    np.testing.assert_array_equal(doc["state"]["opt"][0], state["opt"][0])
+    assert doc["metadata"] == {"rng": [9]}
+
+
+def test_inventory_exchange_pins_own_view():
+    eng = FakeEngine(rank=0, size=2, epoch=0)
+    _seed_full_set(3, 0, _np_state(3.0))
+    inv = replication.send_inventory(eng)
+    assert inv[3]["shards"] == [0, 1]
+    assert len(eng.sent) == 1  # broadcast to the one peer
+    assert replication.inventories(0)[0] == inv  # pinned for election
+    assert replication.inventories(1) == {}  # stale-epoch views invisible
+
+
+def test_reshard_reships_newest_step_to_new_partner():
+    eng = FakeEngine(rank=0, size=2, epoch=1)
+    _seed_full_set(8, 0, _np_state(8.0))
     replication.bump_epoch(1)
-    assert replication.best(epoch=1).step == 4
-    assert replication.best(epoch=0) is None
+    n = replication.reshard(eng)
+    assert n == 2  # both held shards re-shipped (relay leg)
+    assert all(dst == 1 for dst, _s, _p in eng.sent)
 
 
 # ---------------------------------------------------------------------------
 # CheckpointManager._restore_from_peers — the acceptance-bar unit tests
 # ---------------------------------------------------------------------------
 
-def _np_state(v: float):
-    return {"w": np.full(4, v, np.float32), "step_arr": np.array(int(v))}
-
-
-def _seed_replica(owner, step, epoch, state):
-    with replication._lock:
-        replication._replicas[owner] = _entry(owner, step, epoch, state)
-
-
 def test_manager_peer_restore_zero_disk_reads(tmp_path, monkeypatch):
-    """A replica at least as new as disk restores with ZERO payload reads
-    from disk, bit-exact against what was replicated."""
+    """A complete epoch-valid shard set at least as new as disk restores
+    with ZERO payload reads from disk, bit-exact."""
     from horovod_tpu.core import engine as core_engine
 
     monkeypatch.setenv("HVD_TPU_CKPT_REPLICATE", "1")
     monkeypatch.setattr(core_engine, "peek_engine",
                         lambda: FakeEngine(rank=1, size=3, epoch=2))
-    _seed_replica(owner=2, step=5, epoch=2, state=_np_state(5.0))
+    _seed_full_set(5, 2, _np_state(5.0), n=3)
     mgr = checkpoint.CheckpointManager(tmp_path / "peer", rank=1, size=1)
     checkpoint.reset_disk_read_count()
     ck = mgr.restore_latest(template=_np_state(0.0), broadcast=False)
@@ -140,7 +270,7 @@ def test_manager_peer_restore_zero_disk_reads(tmp_path, monkeypatch):
 
 def test_manager_peer_restore_stale_epoch_falls_back_to_disk(tmp_path,
                                                              monkeypatch):
-    """An epoch-stale replica (newer step!) must lose to the committed
+    """An epoch-stale shard set (newer step!) must lose to the committed
     disk checkpoint from the current membership."""
     from horovod_tpu.core import engine as core_engine
 
@@ -149,7 +279,7 @@ def test_manager_peer_restore_stale_epoch_falls_back_to_disk(tmp_path,
                         lambda: FakeEngine(rank=0, size=2, epoch=3))
     mgr = checkpoint.CheckpointManager(tmp_path / "stale", rank=0, size=1)
     mgr.save(2, _np_state(2.0))
-    _seed_replica(owner=1, step=9, epoch=1, state=_np_state(9.0))  # stale
+    _seed_full_set(9, 1, _np_state(9.0))  # stale epoch
     checkpoint.reset_disk_read_count()
     ck = mgr.restore_latest(template=_np_state(0.0), broadcast=False)
     assert ck is not None and ck.step == 2  # disk won
@@ -158,8 +288,8 @@ def test_manager_peer_restore_stale_epoch_falls_back_to_disk(tmp_path,
 
 
 def test_manager_peer_restore_prefers_newer_disk(tmp_path, monkeypatch):
-    """Disk strictly newer than the (epoch-valid) replica wins — a replica
-    must never roll training back past a committed checkpoint."""
+    """Disk strictly newer than the (epoch-valid) shard set wins — a
+    replica must never roll training back past a committed checkpoint."""
     from horovod_tpu.core import engine as core_engine
 
     monkeypatch.setenv("HVD_TPU_CKPT_REPLICATE", "1")
@@ -167,7 +297,7 @@ def test_manager_peer_restore_prefers_newer_disk(tmp_path, monkeypatch):
                         lambda: FakeEngine(rank=0, size=2, epoch=0))
     mgr = checkpoint.CheckpointManager(tmp_path / "newer", rank=0, size=1)
     mgr.save(8, _np_state(8.0))
-    _seed_replica(owner=1, step=4, epoch=0, state=_np_state(4.0))
+    _seed_full_set(4, 0, _np_state(4.0))
     ck = mgr.restore_latest(template=_np_state(0.0), broadcast=False)
     assert ck is not None and ck.step == 8
 
@@ -179,7 +309,7 @@ def test_manager_peer_restore_disabled_without_knob(tmp_path, monkeypatch):
     monkeypatch.delenv("HOROVOD_CKPT_REPLICATE", raising=False)
     monkeypatch.setattr(core_engine, "peek_engine",
                         lambda: FakeEngine(rank=0, size=2, epoch=0))
-    _seed_replica(owner=1, step=9, epoch=0, state=_np_state(9.0))
+    _seed_full_set(9, 0, _np_state(9.0))
     mgr = checkpoint.CheckpointManager(tmp_path / "off", rank=0, size=1)
     assert mgr.restore_latest(template=_np_state(0.0), broadcast=False) \
         is None
