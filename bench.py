@@ -71,9 +71,29 @@ Two phases, one JSON metric line each:
    state moved ZERO payload bytes through the coordinator star
    (``replication_stats()["bytes_shipped_relay"] == 0`` on every rank).
 
+2e. **Long-context transformer bench** — trains the planner-wired
+   long-context transformer (one ``plan_context`` decision per size:
+   layout, VMEM-fit kernel tiles, remat — nothing hand-set) at
+   ``BENCH_LONGCTX_SEQS`` (default 8K/32K/128K; 128K is the 8-chip
+   headline target), one JSON line per size::
+
+       {"metric": "longctx_train_tokens_per_s", "value": N,
+        "unit": "tok/s", "seq_len": S, "mfu": F,
+        "vs_baseline": <mfu / r5 42% hand-tuned baseline>,
+        "plan": {...}}
+
+   ``mfu`` divides achieved model FLOP/s by ``BENCH_PEAK_TFLOPS`` per
+   chip (default 197, v5e bf16); the acceptance bar is >= 55% at S=32K
+   plus a completing S=128K demo across 8 chips (docs/benchmarks.md).
+   On CPU sim meshes the phase still runs — interpret-mode kernels make
+   the timing meaningless, so sizes cap at ``BENCH_LONGCTX_CPU_SEQ``
+   (default 512), a small model is swapped in, and ``mfu``/
+   ``vs_baseline`` are null: the line then documents the PLAN (and that
+   the wired path trains) rather than the throughput.
+
 ``BENCH_SKIP_EAGER=1`` / ``BENCH_SKIP_RESNET=1`` / ``BENCH_SKIP_PLAN=1``
-/ ``BENCH_SKIP_CKPT=1`` / ``BENCH_SKIP_DATAPLANE=1`` skip individual
-phases.
+/ ``BENCH_SKIP_CKPT=1`` / ``BENCH_SKIP_DATAPLANE=1`` /
+``BENCH_SKIP_LONGCTX=1`` skip individual phases.
 
 3. **Fault-detection MTTR** (``bench.py --fault``) — two-process engine
    job; rank 1 is SIGKILLed at steady state and the survivor's
@@ -590,6 +610,121 @@ def overlap_plan_microbench() -> None:
     }))
 
 
+R5_LONGCTX_MFU = 0.42  # hand-tuned S=8K zigzag run, docs/benchmarks.md r5
+
+
+def longctx_bench() -> None:
+    """Long-context transformer throughput with the planner in charge.
+
+    For each sequence length, ONE ``plan_long_context`` call decides the
+    layout (zigzag for causal multi-shard), the flash tiles (VMEM-fit-
+    clamped), and the remat policy; the model wires itself from the plan
+    (``TransformerConfig.context_plan``).  The per-size JSON line carries
+    the plan next to the number — a tokens/s figure is uninterpretable
+    without knowing which layout and tiles produced it.  MFU counts
+    matmul FLOPs (6·P per token fwd+bwd) plus the causal attention
+    FLOPs (6·L·S·H·D) against ``BENCH_PEAK_TFLOPS``/chip.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models import Transformer, TransformerConfig
+    from horovod_tpu.parallel import plan_long_context, shard_sequence
+
+    hvd.init()
+    on_tpu = jax.default_backend() == "tpu"
+    n = hvd.num_chips()
+    mesh = Mesh(np.array(jax.devices()), ("sp",))
+    seqs = [int(s) for s in os.environ.get(
+        "BENCH_LONGCTX_SEQS", "8192,32768,131072").split(",")]
+    if on_tpu:
+        layers, heads, embed = 8, 16, 2048
+        steps = int(os.environ.get("BENCH_LONGCTX_STEPS", "10"))
+    else:
+        # Interpret-mode pallas makes CPU timing meaningless; keep the
+        # phase alive (the plan + the wired path training IS the signal)
+        # but small.
+        layers, heads, embed = 2, 4, 128
+        cap = int(os.environ.get("BENCH_LONGCTX_CPU_SEQ", "512"))
+        seqs = sorted({min(s, cap) for s in seqs})
+        steps = int(os.environ.get("BENCH_LONGCTX_STEPS", "2"))
+    head_dim, mlp = embed // heads, 4 * embed
+    peak = float(os.environ.get("BENCH_PEAK_TFLOPS", "197")) * 1e12
+
+    for seq in seqs:
+        if seq % (2 * n):
+            seq = max(2 * n, seq - seq % (2 * n))
+        s_local = seq // n
+        plan = plan_long_context(
+            seq_len=seq, num_heads=heads, head_dim=head_dim, width=n,
+            embed_dim=embed, mlp_dim=mlp, num_layers=layers)
+        base = dict(vocab_size=32000, num_layers=layers, num_heads=heads,
+                    head_dim=head_dim, embed_dim=embed, mlp_dim=mlp,
+                    max_seq_len=seq)
+        model = Transformer(TransformerConfig(**base, context_axis="sp",
+                                              context_plan=plan))
+        params = Transformer(TransformerConfig(**base)).init(
+            jax.random.PRNGKey(0), jnp.zeros((1, s_local), jnp.int32))
+        opt = optax.adamw(3e-4)
+        opt_state = opt.init(params)
+
+        def sharded(params, tokens):
+            def loss_fn(p):
+                ce = optax.softmax_cross_entropy_with_integer_labels
+                logits = model.apply(p, tokens)
+                if plan.layout == "zigzag":
+                    c = s_local // 2
+                    loss = 0.5 * (
+                        ce(logits[:, :c - 1], tokens[:, 1:c]).mean()
+                        + ce(logits[:, c:-1], tokens[:, c + 1:]).mean())
+                else:
+                    loss = ce(logits[:, :-1], tokens[:, 1:]).mean()
+                return jax.lax.pmean(loss, "sp")
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            return jax.tree.map(lambda g: jax.lax.pmean(g, "sp"),
+                                grads), loss
+
+        @jax.jit
+        def train_step(params, opt_state, tokens):
+            grads, loss = jax.shard_map(
+                sharded, mesh=mesh, in_specs=(P(), P(None, "sp")),
+                out_specs=(P(), P()), check_vma=False)(params, tokens)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        tokens = shard_sequence(
+            jnp.asarray(np.random.RandomState(0).randint(
+                0, 32000, (1, seq))), plan)
+        params, opt_state, loss = train_step(params, opt_state, tokens)
+        float(loss)  # compile + warm step, hard sync
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, loss = train_step(params, opt_state, tokens)
+        float(loss)
+        tok_s = seq * steps / (time.perf_counter() - t0)
+
+        hd = heads * head_dim
+        p_matmul = layers * (4 * embed * hd + 3 * embed * mlp) + embed * 32000
+        flops_per_tok = 6 * p_matmul + 6 * layers * seq * hd
+        mfu = (round(flops_per_tok * tok_s / (n * peak), 4)
+               if on_tpu else None)
+        print(json.dumps({
+            "metric": "longctx_train_tokens_per_s",
+            "value": round(tok_s, 1),
+            "unit": "tok/s",
+            "seq_len": seq,
+            "mfu": mfu,
+            "vs_baseline": (round(mfu / R5_LONGCTX_MFU, 3)
+                            if mfu is not None else None),
+            "plan": plan.as_dict(),
+        }))
+
+
 def main() -> None:
     if "--fault" in sys.argv:
         if "--elastic" in sys.argv:
@@ -605,6 +740,8 @@ def main() -> None:
         checkpoint_bench()
     if os.environ.get("BENCH_SKIP_DATAPLANE") != "1":
         dataplane_bench()
+    if os.environ.get("BENCH_SKIP_LONGCTX") != "1":
+        longctx_bench()
     if os.environ.get("BENCH_SKIP_RESNET") == "1":
         return
     import jax

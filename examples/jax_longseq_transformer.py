@@ -1,12 +1,21 @@
-"""Long-context training with ring attention — sequence parallelism.
+"""Long-context training with ring attention — planner-decided layout.
 
 No reference analog (the reference predates sequence parallelism; SURVEY
 §2.9) — this is the first-class long-context path the TPU rebuild adds: the
 sequence dimension is sharded over the mesh, ring attention streams K/V
 blocks around the ICI ring (parallel/ring_attention.py), and each chip only
 ever holds S/n of the activations, so max trainable context scales linearly
-with chips.  Swap ``make_ring_attention`` for ``make_ulysses_attention`` to
-use all-to-all head parallelism instead.
+with chips.
+
+Nothing here hand-sets a layout, kernel tile, or remat flag: one
+``plan_long_context`` call (ops/schedule_plan.plan_context) decides
+plain-vs-zigzag, the flash ``block_q``/``block_k`` (VMEM-fit-clamped),
+and whether full-layer remat is still worth paying once ring sharding has
+already cut per-chip activations 1/width.  ``TransformerConfig`` takes the
+context axis plus the plan and wires attention and positions itself.
+Override per run with ``HVD_TPU_CTX_LAYOUT`` / ``HVD_TPU_CTX_BLOCK_Q`` /
+``HVD_TPU_CTX_BLOCK_K`` / ``HVD_TPU_CTX_REMAT`` (utils/env.py) — the CLI
+deliberately has no knobs for them.
 """
 
 from __future__ import annotations
@@ -22,7 +31,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 import horovod_tpu as hvd
 from horovod_tpu.models import Transformer, TransformerConfig
-from horovod_tpu.parallel import make_ring_attention, make_ring_flash_attention
+from horovod_tpu.parallel import plan_long_context, shard_sequence
 
 
 def main():
@@ -33,16 +42,10 @@ def main():
     ap.add_argument("--embed", type=int, default=512)
     ap.add_argument("--steps", type=int, default=5)
     ap.add_argument("--batch", type=int, default=1)
-    ap.add_argument("--flash", action="store_true",
-                    help="fuse each ring step with the pallas flash kernel "
-                         "(O(S/n · D) per-step memory instead of O((S/n)²))")
-    ap.add_argument("--zigzag", action="store_true",
-                    help="zigzag sequence layout: balances causal work "
-                         "across the ring (implies --flash)")
-    ap.add_argument("--remat", action="store_true",
-                    help="rematerialize each block in backward "
-                         "(jax.checkpoint) — pairs with sequence "
-                         "parallelism for very long S")
+    ap.add_argument("--headroom-mb", type=float, default=None,
+                    help="per-chip HBM headroom to hand the planner "
+                         "(default: let it assume the built-in remat "
+                         "threshold; on chips, pass the PR-8 probe value)")
     args = ap.parse_args()
 
     hvd.init()
@@ -50,18 +53,20 @@ def main():
     mesh = Mesh(np.array(jax.devices()), ("sp",))
     s_local = args.seq_len // n
 
+    plan = plan_long_context(
+        seq_len=args.seq_len, num_heads=args.heads,
+        head_dim=args.embed // args.heads, width=n, batch=args.batch,
+        embed_dim=args.embed, mlp_dim=4 * args.embed, num_layers=args.layers,
+        headroom_mb=args.headroom_mb)
+    if hvd.rank() == 0:
+        print(f"context plan: {plan.as_dict()}")
+
     base = dict(vocab_size=32000, num_layers=args.layers,
                 num_heads=args.heads, head_dim=args.embed // args.heads,
                 embed_dim=args.embed, mlp_dim=4 * args.embed,
-                max_seq_len=args.seq_len, remat=args.remat)
-    if args.zigzag:
-        from horovod_tpu.parallel import make_zigzag_ring_flash_attention
-
-        attn = make_zigzag_ring_flash_attention("sp")
-    else:
-        attn = (make_ring_flash_attention("sp") if args.flash
-                else make_ring_attention("sp"))
-    model = Transformer(TransformerConfig(**base, attention_fn=attn))
+                max_seq_len=args.seq_len)
+    model = Transformer(TransformerConfig(**base, context_axis="sp",
+                                          context_plan=plan))
     init_model = Transformer(TransformerConfig(**base))
     params = init_model.init(jax.random.PRNGKey(0),
                              jnp.zeros((1, s_local), jnp.int32))
@@ -73,11 +78,11 @@ def main():
         def sharded(params, tokens):
             def loss_fn(p):
                 ce = optax.softmax_cross_entropy_with_integer_labels
-                if args.zigzag:
-                    from horovod_tpu.parallel import zigzag_positions
-
-                    logits = model.apply(
-                        p, tokens, positions=zigzag_positions(s_local, "sp"))
+                # Positions come from the plan inside the model — the
+                # shard's tokens just need the matching layout
+                # (shard_sequence below, before sharding).
+                logits = model.apply(p, tokens)
+                if plan.layout == "zigzag":
                     # Next-token shift is only valid within a contiguous
                     # chunk; the zigzag shard is two chunks — shift each.
                     c = s_local // 2
@@ -85,8 +90,6 @@ def main():
                         ce(logits[:, :c - 1], tokens[:, 1:c]).mean()
                         + ce(logits[:, c:-1], tokens[:, c + 1:]).mean())
                 else:
-                    offset = jax.lax.axis_index("sp") * s_local
-                    logits = model.apply(p, tokens, position_offset=offset)
                     loss = ce(logits[:, :-1], tokens[:, 1:]).mean()
                 # Mean over sequence shards = global mean over the sequence.
                 return jax.lax.pmean(loss, "sp")
@@ -103,10 +106,9 @@ def main():
 
     rng = np.random.RandomState(0)
     tokens = jnp.asarray(rng.randint(0, 32000, (args.batch, args.seq_len)))
-    if args.zigzag:
-        from horovod_tpu.parallel import zigzag_permutation
-
-        tokens = tokens[:, zigzag_permutation(args.seq_len, n)]
+    # Pre-permute so a contiguous P(None, "sp") shard lands the planned
+    # layout (identity when the plan chose plain).
+    tokens = shard_sequence(tokens, plan)
     loss = None
     for i in range(args.steps):
         t0 = time.time()
@@ -116,7 +118,7 @@ def main():
             tok_s = args.batch * args.seq_len / (time.time() - t0)
             print(f"step {i}: loss={float(loss):.3f} {tok_s:.0f} tok/s "
                   f"(seq {args.seq_len} over {n} chips, "
-                  f"{s_local}/chip)")
+                  f"{s_local}/chip, layout={plan.layout})")
 
 
 if __name__ == "__main__":

@@ -53,13 +53,15 @@ for _mod, _names in {
         "replicated_sharding",
     ),
     "horovod_tpu.ops": (
-        "AdaptivePlanner", "BucketPlan", "Compression", "GradientManifest",
+        "AdaptivePlanner", "BucketPlan", "Compression", "ContextPlan",
+        "ContextWorkload", "GradientManifest",
         "Planner", "StaticPlanner", "allgather", "allgather_async",
         "allreduce",
         "allreduce_async", "allreduce_sparse", "alltoall", "alltoall_async",
         "barrier", "batch_spec", "broadcast", "broadcast_async",
+        "context_plan",
         "flash_attention", "grouped_allreduce", "make_flash_attention",
-        "overlap_compiler_options", "overlap_plan", "poll",
+        "overlap_compiler_options", "overlap_plan", "plan_context", "poll",
         "quantized_grouped_allreduce",
         "shard",
         "softmax_cross_entropy", "sparse_to_dense", "synchronize",

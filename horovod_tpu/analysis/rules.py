@@ -614,6 +614,80 @@ class HandTunedOverlapKnob(Rule):
         return out
 
 
+class HandTunedContextLayout(Rule):
+    """Hand-set long-context layout or flash kernel tiles.
+
+    Since the context planner (ops/schedule_plan.py ``plan_context``) the
+    sequence layout and the flash ``block_q``/``block_k`` are one joint
+    decision from one memory model: causal multi-shard work routes to the
+    zigzag layout (on the plain ring, rank r's first ``n-1-r`` steps
+    attend fully-masked K blocks — the planner retires that idle
+    triangle), and tiles are clamped to the kernel's VMEM budget (the
+    hand-picked ``block_k=4096`` that wins at S=8K OOMs at S=32K).  Two
+    idioms opt out of that by accident:
+
+    * calling ``ring_flash_attention`` with ``causal=True`` (or leaving
+      ``causal`` to its True default) — causal work on the plain layout;
+    * passing integer-literal ``block_q=``/``block_k=`` to any ring
+      attention entry point — tiles pinned at one sequence length.
+
+    Passing variables (e.g. ``plan.block_q``) is fine — that is the
+    planner speaking.  Audit/fixture sites that pin the plain causal path
+    on purpose carry ``# hvd-lint: disable=HVD108``.
+    """
+
+    code = "HVD108"
+    name = "hand-tuned-context-layout"
+    hint = ("derive layout and kernel tiles from one plan: "
+            "ops/schedule_plan.plan_context (parallel/context.py wires it "
+            "into a TransformerConfig); mark deliberate plain-causal "
+            "fixtures with `# hvd-lint: disable=HVD108`")
+
+    # call name -> (positional index of causal, of block_q, of block_k);
+    # causal None = the entry point has no causal parameter at call time.
+    _RING_CALLS = {
+        "ring_flash_attention": (4, 5, 6),
+        "zigzag_ring_flash_attention": (4, 5, 6),
+        "make_ring_flash_attention": (None, 1, 2),
+        "make_zigzag_ring_flash_attention": (None, 1, 2),
+    }
+
+    @staticmethod
+    def _arg(node: ast.Call, idx: int | None, name: str) -> ast.expr | None:
+        if idx is not None and len(node.args) > idx:
+            return node.args[idx]
+        return kwarg(node, name)
+
+    def run(self, ctx: Context) -> list[Finding]:
+        out: list[Finding] = []
+        for node in ast.walk(ctx.module):
+            if not isinstance(node, ast.Call):
+                continue
+            cname = call_name(node)
+            if cname not in self._RING_CALLS:
+                continue
+            causal_idx, bq_idx, bk_idx = self._RING_CALLS[cname]
+            if cname == "ring_flash_attention":
+                causal = self._arg(node, causal_idx, "causal")
+                if causal is None or (isinstance(causal, ast.Constant)
+                                      and causal.value is True):
+                    out.append(self.finding(node, (
+                        "causal attention on the plain ring layout: rank "
+                        "r's first n-1-r steps attend fully-masked K "
+                        "blocks — plan_context routes causal multi-shard "
+                        "work to the zigzag layout instead")))
+            for bname, bidx in (("block_q", bq_idx), ("block_k", bk_idx)):
+                val = self._arg(node, bidx, bname)
+                if isinstance(val, ast.Constant) and \
+                        isinstance(val.value, int):
+                    out.append(self.finding(node, (
+                        f"'{cname}' pins {bname}={val.value}: a tile that "
+                        f"fits one sequence length VMEM-OOMs at another — "
+                        f"plan_context clamps tiles to the kernel budget "
+                        f"per workload")))
+        return out
+
+
 RULES: list[Rule] = [
     RankDivergentCollective(),
     UnnamedCollectiveInLoop(),
@@ -622,4 +696,5 @@ RULES: list[Rule] = [
     UnknownAxisName(),
     StaleTopologyConstant(),
     HandTunedOverlapKnob(),
+    HandTunedContextLayout(),
 ]

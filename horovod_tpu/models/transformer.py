@@ -64,7 +64,19 @@ class TransformerConfig:
     # activation memory drops from O(L) layer working sets to one layer +
     # L boundary tensors — the FLOPs-for-HBM trade long-context training
     # needs (S=32K training OOMs 15.75G HBM without it; fits with it).
+    # With a context_plan set, the plan's remat decision wins (ring
+    # sharding shrinks per-chip activations 1/width, typically dropping
+    # full-layer remat — the ~17 MFU points BENCH r5 measured it costing).
     remat: bool = False
+    # Context-parallel mesh axis: set (with context_plan) to route
+    # attention through the planner-decided ring/zigzag flash path and
+    # derive per-shard positions from the layout.  Call inside shard_map
+    # over this axis with the sequence dimension sharded; explicit
+    # attention_fn/positions win when given.
+    context_axis: str | None = None
+    # The ContextPlan (ops/schedule_plan.plan_context) that decided the
+    # layout, kernel tiles, and remat policy for this model.
+    context_plan: Any = None
 
 
 def rope(x, positions, theta: float):
@@ -103,7 +115,12 @@ class Attention(nn.Module):
         q = rope(proj("q")(x), positions, cfg.rope_theta)
         k = rope(proj("k")(x), positions, cfg.rope_theta)
         v = proj("v")(x)
-        attn = cfg.attention_fn or dense_causal_attention
+        attn = cfg.attention_fn
+        if attn is None and cfg.context_axis and cfg.context_plan is not None:
+            from horovod_tpu.parallel.context import context_attention_fn
+
+            attn = context_attention_fn(cfg.context_axis, cfg.context_plan)
+        attn = attn or dense_causal_attention
         out = attn(q, k, v, causal=True)
         return nn.DenseGeneral(cfg.embed_dim, axis=(-2, -1), use_bias=False,
                                dtype=cfg.dtype,
@@ -154,7 +171,10 @@ class Transformer(nn.Module):
     shard computes RoPE/causal masks at its global coordinates.  For
     non-contiguous layouts (zigzag ring attention), pass explicit
     ``positions`` ([S] or [B, S] global coordinates) instead — e.g.
-    ``parallel.zigzag_positions(s_local, axis)``.
+    ``parallel.zigzag_positions(s_local, axis)``.  With
+    ``cfg.context_axis`` + ``cfg.context_plan`` set, positions, the
+    attention path, and the remat policy all derive from the plan (see
+    ``parallel/context.py``); explicit arguments still win.
     """
 
     cfg: TransformerConfig
@@ -164,13 +184,21 @@ class Transformer(nn.Module):
         cfg = self.cfg
         x = nn.Embed(cfg.vocab_size, cfg.embed_dim, dtype=cfg.dtype,
                      param_dtype=cfg.param_dtype, name="embed")(tokens)
+        if positions is None and cfg.context_axis and \
+                cfg.context_plan is not None:
+            from horovod_tpu.parallel.context import context_positions
+
+            positions = context_positions(cfg.context_axis,
+                                          tokens.shape[1], cfg.context_plan)
         if positions is None:
             positions = (jnp.arange(tokens.shape[1])[None, :]
                          + jnp.asarray(position_offset))
         elif positions.ndim == 1:
             positions = positions[None, :]
         positions = jnp.broadcast_to(positions, tokens.shape)
-        block_cls = nn.remat(Block) if cfg.remat else Block
+        remat_on = (cfg.remat if cfg.context_plan is None
+                    else cfg.context_plan.remat)
+        block_cls = nn.remat(Block) if remat_on else Block
         for i in range(cfg.num_layers):
             x = block_cls(cfg, name=f"layer_{i}")(x, positions)
         x = FusedRMSNorm(dtype=cfg.dtype, param_dtype=cfg.param_dtype,

@@ -15,10 +15,14 @@ from horovod_tpu.ops.compression import Compression  # noqa: F401
 from horovod_tpu.ops.schedule_plan import (  # noqa: F401
     AdaptivePlanner,
     BucketPlan,
+    ContextPlan,
+    ContextWorkload,
     GradientManifest,
     Planner,
     StaticPlanner,
+    context_plan,
     overlap_plan,
+    plan_context,
 )
 from horovod_tpu.ops.flash_attention import (  # noqa: F401
     flash_attention,

@@ -256,6 +256,69 @@ def _sub_fit(block: int, sub: int) -> tuple[int, int]:
     return block, sub
 
 
+# Per-core VMEM is 16 MiB; the fit budget sits below it because this
+# estimate cannot see Mosaic's scheduling windows — exactly how the
+# hand-set block_k=4096 passed review at S=8192 and then overflowed the
+# remat backward at S=32768 (docs/benchmarks.md round 5).  Requested
+# blocks whose estimated resident set exceeds the budget are halved with
+# a warning instead of failing inside Pallas.
+VMEM_LIMIT_MB = 16.0
+VMEM_FIT_BUDGET_MB = 13.0
+_VMEM_MIN_BLOCK = 128
+_vmem_clamp_warned: set = set()
+
+
+def _vmem_estimate_bytes(block_q: int, block_k: int, d: int,
+                         sub: int = 1024, itemsize: int = 2) -> int:
+    """Resident-set model of the worst pass (backward dq): double-buffered
+    K/V streaming super tiles, q/dO tiles, the f32 dq accumulator, the
+    sublane-replicated lse/Δ rows, and two live [block_q, sub] f32 compute
+    tiles (Mosaic fuses the elementwise chain, so s/p/dp/ds share ~two
+    buffers in practice)."""
+    sub_k = min(sub, max(block_k, 1))
+    kv = 2 * 2 * block_k * d * itemsize          # K+V, double-buffered
+    qdo = 2 * 2 * block_q * d * itemsize         # q + dO tiles
+    acc = block_q * d * 4                        # f32 dq/o accumulator
+    stats = 2 * 8 * block_q * 4                  # lse + Δ, sublane-replicated
+    tiles = 2 * block_q * sub_k * 4              # live f32 compute tiles
+    return kv + qdo + acc + stats + tiles
+
+
+def clamp_blocks_to_vmem(block_q: int, block_k: int, d: int,
+                         sub: int = 1024, itemsize: int = 2,
+                         where: str = "flash_attention") -> tuple[int, int]:
+    """Halve (block_k first — the K/V tiles dominate — then block_q, never
+    below 128) until :func:`_vmem_estimate_bytes` fits the VMEM budget.
+    One-line rank-0 warning per distinct clamp; ``ContextPlan`` routes
+    through the same estimate so planned configs never trip it."""
+    bq, bk = block_q, block_k
+    budget = int(VMEM_FIT_BUDGET_MB * 2 ** 20)
+    while _vmem_estimate_bytes(bq, bk, d, sub, itemsize) > budget:
+        if bk > _VMEM_MIN_BLOCK and bk >= bq:
+            bk //= 2
+        elif bq > _VMEM_MIN_BLOCK:
+            bq //= 2
+        elif bk > _VMEM_MIN_BLOCK:
+            bk //= 2
+        else:
+            break
+    if (bq, bk) != (block_q, block_k):
+        key = (where, block_q, block_k, bq, bk, d, itemsize)
+        if key not in _vmem_clamp_warned:
+            _vmem_clamp_warned.add(key)
+            if jax.process_index() == 0:
+                import warnings
+
+                warnings.warn(
+                    f"{where}: block_q/block_k={block_q}/{block_k} at d={d} "
+                    f"itemsize={itemsize} estimated over the "
+                    f"{VMEM_FIT_BUDGET_MB:g} MiB VMEM fit budget — clamped "
+                    f"to {bq}/{bk} (derive kernel params from "
+                    f"ops.schedule_plan.plan_context instead of "
+                    f"hand-setting them).", stacklevel=3)
+    return bq, bk
+
+
 def _flash_forward(q, k, v, causal, q_offset, k_offset, block_q, block_k,
                    interpret, *, sub: int = 1024, with_lse: bool = False):
     b, s_q, h, d = q.shape
@@ -559,6 +622,9 @@ def flash_attention_backward(q, k, v, dout, lse, delta, causal,
     # shards up to the block size and double the backward work.
     block_q = min(block_q, max(s_q, 1))
     block_k = min(block_k, max(s_k, 1))
+    block_q, block_k = clamp_blocks_to_vmem(
+        block_q, block_k, d, sub, q.dtype.itemsize,
+        where="flash_attention_backward")
     block_q, sub_q = _sub_fit(block_q, sub)
     block_k, sub_k = _sub_fit(block_k, sub)
     # The dk/dv pass's k tile is BOTH its resident accumulator width and
@@ -735,6 +801,8 @@ def flash_attention(q, k, v, causal: bool = True, q_offset=0, k_offset=0,
         block_k = _default_block_k(k.shape[1], q.shape[-1])
     block_q = min(block_q, max(q.shape[1], 1))
     block_k = min(block_k, max(k.shape[1], 1))
+    block_q, block_k = clamp_blocks_to_vmem(
+        block_q, block_k, q.shape[-1], sub, q.dtype.itemsize)
     return _flash(q, k, v, causal, q_offset, k_offset, block_q, block_k,
                   sub, interpret)
 
@@ -757,6 +825,9 @@ def flash_attention_with_lse(q, k, v, causal: bool = True, q_offset=0,
         block_k = _default_block_k(k.shape[1], q.shape[-1])
     block_q = min(block_q, max(q.shape[1], 1))
     block_k = min(block_k, max(k.shape[1], 1))
+    block_q, block_k = clamp_blocks_to_vmem(
+        block_q, block_k, q.shape[-1], sub, q.dtype.itemsize,
+        where="flash_attention_with_lse")
     return _flash_forward(q, k, v, causal, q_offset, k_offset, block_q,
                           block_k, interpret, sub=sub, with_lse=True)
 

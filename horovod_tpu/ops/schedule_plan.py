@@ -394,9 +394,251 @@ def _emit_timeline(plan: BucketPlan) -> None:
 
 def _reset_for_tests() -> None:
     """Drop the cached probe/log state (test isolation only)."""
-    global _last_plan
+    global _last_plan, _last_context_plan
     with _probe_lock:
         _probe_cache.clear()
     with _plan_lock:
         _last_plan = None
         _logged_keys.clear()
+        _last_context_plan = None
+        _context_logged_keys.clear()
+
+
+# ---------------------------------------------------------------------------
+# ContextPlan: long-context layout planning (ring/zigzag flash attention)
+# ---------------------------------------------------------------------------
+# The same trace-time discipline as BucketPlan, applied to sequence
+# parallelism: shard width, plain-vs-zigzag layout, the flash kernel's
+# block_q/block_k, and the remat policy are one decision from one memory
+# model, not four hand-set knobs.  The motivating failure (BENCH r5,
+# docs/benchmarks.md): block_k=4096 wins at S=8192 but VMEM-OOMs the remat
+# backward at S=32768 — tile choices must be VMEM-fit-clamped per workload.
+
+# Deterministic remat fallback when no headroom estimate exists (CPU/sim/
+# AOT with no HVD_TPU_DEVICE_HEADROOM_MB): remat engages past this many MB
+# of estimated per-chip activations.  The value is the r5-measured HBM
+# slack of the 32K single-chip row; with ring sharding active the per-chip
+# activation estimate shrinks by 1/width and typically drops below it —
+# which is exactly the "ring path drops full-layer remat" behavior.
+DEFAULT_CTX_REMAT_THRESHOLD_MB = 2048.0
+
+
+@dataclasses.dataclass(frozen=True)
+class ContextWorkload:
+    """Static description of one long-context training workload — every
+    field is a Python int/bool at trace time, so the plan is a
+    deterministic function of (workload, width, headroom) on every rank
+    (the SPMD discipline :class:`Planner` documents)."""
+
+    seq_len: int
+    num_heads: int
+    head_dim: int
+    batch: int = 1
+    embed_dim: int = 0       # 0 -> num_heads * head_dim
+    mlp_dim: int = 0         # 0 -> 4 * model_dim
+    num_layers: int = 1
+    causal: bool = True
+    dtype_bytes: int = 2     # bf16 activations
+
+    @property
+    def model_dim(self) -> int:
+        return self.embed_dim or self.num_heads * self.head_dim
+
+    @property
+    def ff_dim(self) -> int:
+        return self.mlp_dim or 4 * self.model_dim
+
+    def activation_mb(self, width: int) -> float:
+        """Estimated per-chip live activation bytes without remat: the
+        residual stream, the attention q/k/v/out set, and the MLP hidden —
+        per layer, per local token.  Coarse on purpose (it prices a binary
+        remat decision, not an allocator)."""
+        per_token = (2 * self.model_dim + 4 * self.num_heads * self.head_dim
+                     + 2 * self.ff_dim) * self.dtype_bytes
+        s_local = max(self.seq_len // max(width, 1), 1)
+        return (self.num_layers * self.batch * s_local * per_token
+                / (1024.0 * 1024.0))
+
+
+@dataclasses.dataclass(frozen=True)
+class ContextPlan:
+    """One planner decision for one long-context workload: the sequence
+    layout (``plain``/``zigzag``), the VMEM-fit flash tile sizes, and the
+    remat policy — consumed by ``parallel/context.py`` and
+    ``models/transformer.py``."""
+
+    planner: str
+    width: int
+    seq_local: int
+    layout: str
+    block_q: int
+    block_k: int
+    remat: bool
+    causal: bool
+    headroom_mb: float | None
+    est_vmem_kb: int
+    est_activation_mb: float
+    reason: str
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def plan_context(workload: ContextWorkload, width: int,
+                 headroom_mb: float | None = None, *,
+                 layout: str | None = None,
+                 block_q: int | None = None,
+                 block_k: int | None = None,
+                 remat: bool | None = None) -> ContextPlan:
+    """Make (and record) the long-context plan for one traced program.
+
+    Resolution order per field — most explicit wins: a keyword argument in
+    code, the ``HVD_TPU_CTX_*`` env override, then the planner decision.
+    Tile overrides are still VMEM-fit-clamped (the whole point: a knob
+    must not be able to reintroduce the r5 block_k=4096 S=32768 OOM).
+    ``headroom_mb`` defaults to :func:`probe_headroom_mb` — the same
+    memory model the bucket planner budgets against.
+    """
+    # (the function re-export in ops/__init__ shadows the submodule name,
+    # so import the pieces, not the module)
+    from horovod_tpu.ops.flash_attention import (
+        _VMEM_MIN_BLOCK, VMEM_FIT_BUDGET_MB, _default_block_k,
+        _vmem_estimate_bytes)
+
+    if width < 1:
+        raise ValueError(f"context width must be >= 1, got {width}")
+    if workload.seq_len % width:
+        raise ValueError(
+            f"seq_len {workload.seq_len} not divisible by context width "
+            f"{width}")
+    s_local = workload.seq_len // width
+    if headroom_mb is None:
+        headroom_mb = probe_headroom_mb()
+
+    why = []
+    layout = layout if layout is not None else env.ctx_layout()
+    if layout in (None, "auto"):
+        zig_ok = workload.seq_len % (2 * width) == 0 and width > 1
+        if workload.causal and zig_ok:
+            layout = "zigzag"
+            why.append("causal multi-shard -> zigzag (balanced causal "
+                       "triangle; plain would idle early ranks)")
+        else:
+            layout = "plain"
+            why.append("plain layout ("
+                       + ("width 1" if width <= 1 else
+                          "non-causal" if not workload.causal else
+                          "seq_len not divisible by 2*width")
+                       + ("; causal step skipping active"
+                          if workload.causal and width > 1 else "") + ")")
+    else:
+        why.append(f"layout pinned to {layout}")
+    if layout == "zigzag" and workload.seq_len % (2 * width):
+        raise ValueError(
+            f"zigzag needs seq_len divisible by 2*width "
+            f"({workload.seq_len} vs width={width})")
+
+    # Per-kernel-call K length: zigzag splits the shard into two chunks.
+    chunk = s_local // 2 if layout == "zigzag" else s_local
+    chunk = max(chunk, 1)
+    bq = block_q if block_q is not None else env.ctx_block_q()
+    bk = block_k if block_k is not None else env.ctx_block_k()
+    pinned = bq is not None or bk is not None
+    if bq is None:
+        bq = min(1024, chunk)
+    if bk is None:
+        bk = _default_block_k(chunk, workload.head_dim)
+    bq, bk = min(bq, chunk), min(bk, chunk)
+    # VMEM-fit clamp against the same resident-set model the kernel entry
+    # points enforce — but silently: a planned reduction IS the plan, only
+    # hand-set values that trip the kernel-side clamp deserve the warning.
+    budget = int(VMEM_FIT_BUDGET_MB * 2 ** 20)
+    fit_bq, fit_bk = bq, bk
+    while _vmem_estimate_bytes(fit_bq, fit_bk, workload.head_dim,
+                                   1024, workload.dtype_bytes) > budget:
+        if fit_bk > _VMEM_MIN_BLOCK and fit_bk >= fit_bq:
+            fit_bk //= 2
+        elif fit_bq > _VMEM_MIN_BLOCK:
+            fit_bq //= 2
+        elif fit_bk > _VMEM_MIN_BLOCK:
+            fit_bk //= 2
+        else:
+            break
+    if (fit_bq, fit_bk) != (bq, bk):
+        why.append(f"VMEM fit: block_q/block_k {bq}/{bk} -> "
+                   f"{fit_bq}/{fit_bk}"
+                   + (" (overriding pinned tiles)" if pinned else ""))
+    bq, bk = fit_bq, fit_bk
+    est_vmem_kb = _vmem_estimate_bytes(
+        bq, bk, workload.head_dim, 1024, workload.dtype_bytes) // 1024
+
+    act_mb = workload.activation_mb(width)
+    remat = remat if remat is not None else env.ctx_remat_override()
+    if remat is None:
+        act_budget = (headroom_mb if headroom_mb is not None
+                      else DEFAULT_CTX_REMAT_THRESHOLD_MB)
+        remat = act_mb > act_budget
+        why.append(
+            f"activations ~{act_mb:.0f}MB vs "
+            + (f"headroom {headroom_mb:.0f}MB" if headroom_mb is not None
+               else f"default budget {act_budget:.0f}MB")
+            + (" -> full-layer remat" if remat
+               else " -> remat dropped (ring shards the sequence)"))
+    else:
+        why.append(f"remat pinned to {remat}")
+
+    plan = ContextPlan(
+        planner="context", width=width, seq_local=s_local, layout=layout,
+        block_q=bq, block_k=bk, remat=bool(remat), causal=workload.causal,
+        headroom_mb=headroom_mb, est_vmem_kb=est_vmem_kb,
+        est_activation_mb=round(act_mb, 3), reason="; ".join(why))
+    _record_context(plan)
+    return plan
+
+
+_last_context_plan: ContextPlan | None = None
+_context_logged_keys: set = set()
+
+
+def context_plan() -> dict | None:
+    """The most recent :class:`ContextPlan` as a dict
+    (``hvd.context_plan()``), or None before any long-context program has
+    been planned.  Keys: planner, width, seq_local, layout, block_q,
+    block_k, remat, causal, headroom_mb, est_vmem_kb, est_activation_mb,
+    reason."""
+    with _plan_lock:
+        return (_last_context_plan.as_dict()
+                if _last_context_plan is not None else None)
+
+
+def _record_context(plan: ContextPlan) -> None:
+    global _last_context_plan
+    key = (plan.width, plan.seq_local, plan.layout, plan.block_q,
+           plan.block_k, plan.remat, plan.causal, plan.headroom_mb)
+    with _plan_lock:
+        _last_context_plan = plan
+        fresh = key not in _context_logged_keys
+        if fresh:
+            _context_logged_keys.add(key)
+    if not fresh:
+        return  # retraces of the same program repeat the same decision
+    if _is_rank0():
+        hr = ("unknown" if plan.headroom_mb is None
+              else f"{plan.headroom_mb:.0f}MB")
+        _log.info(
+            "context plan: width=%d s_local=%d layout=%s block_q=%d "
+            "block_k=%d remat=%s headroom=%s — %s", plan.width,
+            plan.seq_local, plan.layout, plan.block_q, plan.block_k,
+            plan.remat, hr, plan.reason)
+    try:
+        from horovod_tpu.core import engine
+
+        eng = engine.peek_engine()
+        if eng is not None:
+            eng.timeline_instant(
+                "context_plan",
+                f"CONTEXT_PLAN width={plan.width} layout={plan.layout} "
+                f"block_q={plan.block_q} block_k={plan.block_k} "
+                f"remat={plan.remat}")
+    except Exception:  # observability must never break tracing
+        pass

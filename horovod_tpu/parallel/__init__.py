@@ -24,10 +24,18 @@ from horovod_tpu.parallel.ring_attention import (  # noqa: F401
     make_zigzag_ring_flash_attention,
     ring_attention,
     ring_flash_attention,
+    ring_flash_attention_stats,
     zigzag_inverse_permutation,
     zigzag_permutation,
     zigzag_positions,
     zigzag_ring_flash_attention,
+)
+from horovod_tpu.parallel.context import (  # noqa: F401
+    context_attention_fn,
+    context_positions,
+    plan_long_context,
+    shard_sequence,
+    unshard_sequence,
 )
 from horovod_tpu.parallel.ulysses import (  # noqa: F401
     ulysses_attention,

@@ -1,0 +1,98 @@
+"""Planner-driven long-context glue: one ContextPlan wires the layout.
+
+``ops/schedule_plan.plan_context`` decides sequence-shard width,
+plain-vs-zigzag layout, the flash kernel's ``block_q``/``block_k`` (VMEM-
+fit-clamped), and the remat policy from one memory model; this module
+turns that plan into the concrete pieces a model needs:
+
+* :func:`plan_long_context` — describe the workload, get the plan
+  (host-side, before tracing);
+* :func:`context_attention_fn` — a ``TransformerConfig.attention_fn``
+  routing to the ring or zigzag flash path with the planned tiles
+  (device-side, inside ``shard_map`` over the context axis);
+* :func:`context_positions` — the rank's global sequence positions per
+  the planned layout (RoPE must match the data layout);
+* :func:`shard_sequence` / :func:`unshard_sequence` — the host-side
+  permutation that makes a contiguous ``P(None, axis)`` shard land the
+  zigzag layout (identity on the plain layout).
+
+No call site hand-sets kernel tiles or picks a layout — that is the
+hvd-lint HVD108 contract (analysis/rules.py).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.ops.schedule_plan import (
+    ContextPlan,
+    ContextWorkload,
+    plan_context,
+)
+from horovod_tpu.parallel.ring_attention import (
+    ring_flash_attention,
+    zigzag_inverse_permutation,
+    zigzag_permutation,
+    zigzag_positions,
+    zigzag_ring_flash_attention,
+)
+
+
+def plan_long_context(seq_len: int, num_heads: int, head_dim: int,
+                      width: int, *, batch: int = 1, embed_dim: int = 0,
+                      mlp_dim: int = 0, num_layers: int = 1,
+                      causal: bool = True, dtype_bytes: int = 2,
+                      headroom_mb: float | None = None,
+                      **overrides) -> ContextPlan:
+    """Describe the workload, get the :class:`ContextPlan`.
+
+    Thin convenience over ``plan_context(ContextWorkload(...), width)``;
+    ``overrides`` (layout=/block_q=/block_k=/remat=) pass through, below
+    the ``HVD_TPU_CTX_*`` env knobs in precedence as documented there.
+    """
+    workload = ContextWorkload(
+        seq_len=seq_len, num_heads=num_heads, head_dim=head_dim,
+        batch=batch, embed_dim=embed_dim, mlp_dim=mlp_dim,
+        num_layers=num_layers, causal=causal, dtype_bytes=dtype_bytes)
+    return plan_context(workload, width, headroom_mb, **overrides)
+
+
+def context_attention_fn(axis_name: str, plan: ContextPlan):
+    """``TransformerConfig.attention_fn`` executing the plan's layout with
+    its VMEM-fit tiles.  Call inside ``shard_map`` over ``axis_name``; at
+    width 1 the ring degenerates to a single flash kernel call (no scan,
+    no ppermute)."""
+    ring = (zigzag_ring_flash_attention if plan.layout == "zigzag"
+            else ring_flash_attention)
+
+    def attn(q, k, v, causal=True):
+        return ring(q, k, v, axis_name, causal, plan.block_q, plan.block_k)
+
+    return attn
+
+
+def context_positions(axis_name: str, s_local: int, plan: ContextPlan):
+    """This rank's global sequence positions ([s_local]) under the plan's
+    layout — zigzag chunks (r, 2n−1−r) or the plain contiguous shard."""
+    if plan.layout == "zigzag":
+        return zigzag_positions(s_local, axis_name)
+    return lax.axis_index(axis_name) * s_local + jnp.arange(s_local)
+
+
+def shard_sequence(x, plan: ContextPlan, axis: int = 1):
+    """Permute a global-order array (tokens, targets) so that a contiguous
+    ``P(None, axis)`` shard over ``plan.width`` ranks lands the planned
+    layout.  Identity on the plain layout."""
+    if plan.layout != "zigzag":
+        return x
+    perm = zigzag_permutation(x.shape[axis], plan.width)
+    return jnp.take(x, perm, axis=axis)
+
+
+def unshard_sequence(x, plan: ContextPlan, axis: int = 1):
+    """Inverse of :func:`shard_sequence` (restores natural order)."""
+    if plan.layout != "zigzag":
+        return x
+    inv = zigzag_inverse_permutation(x.shape[axis], plan.width)
+    return jnp.take(x, inv, axis=axis)

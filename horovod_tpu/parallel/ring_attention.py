@@ -4,11 +4,17 @@ Not in the reference (it predates the technique; SURVEY §2.9) but first-class
 here: long sequences are sharded across a mesh axis, each chip keeps its
 query block resident, and key/value blocks rotate around the ring via
 ``lax.ppermute`` while a flash-style online softmax accumulates the exact
-result.  Peak memory per chip is O(S/n) and the K/V transfer for step i+1
-overlaps the block matmul for step i (XLA schedules the ppermute
-asynchronously on ICI) — the TPU-native form of ring attention
-(Liu et al. 2023) built from the same collective vocabulary as the data
-plane.
+result.  Peak memory per chip is O(S/n), and the flash ring passes are
+double-buffered: each scan step issues the next block's ``ppermute``
+BEFORE its own kernel, so the ICI transfer is structurally independent of
+the same step's attention output and overlaps its compute (pinned by
+``examples/longctx_audit.py``).  Causal runs on the plain layout skip the
+fully-masked ring steps outright (exact, via the lse-merge identity); the
+zigzag layout balances the causal triangle across ranks instead.  Layout
+and kernel parameters are planner-decided — see
+``ops/schedule_plan.plan_context`` and ``parallel/context.py`` — the
+TPU-native form of ring attention (Liu et al. 2023) built from the same
+collective vocabulary as the data plane.
 
 Numerics: logits and softmax statistics in float32, block matmuls in the
 input dtype (bf16 on the MXU); fully-masked blocks are handled by masking
@@ -113,7 +119,18 @@ def _merge_partial(out, lse, o_i, lse_i):
 
 
 def _ring_flash_forward(q, k, v, axis_name, causal, block_q, block_k):
-    """Forward ring pass; returns (out_f32, merged lse)."""
+    """Forward ring pass; returns (out_f32, merged lse, steps_run).
+
+    Double-buffered: each scan step issues the NEXT K/V ``ppermute`` before
+    this step's flash kernel, so the ICI transfer is never data-dependent on
+    the same step's attention output and overlaps its compute.  The final
+    step is unrolled outside the scan — there is no next block to fetch, so
+    the old code's wasted n-th rotation disappears.  On the plain causal
+    layout, steps whose whole K block sits above the diagonal are skipped
+    (merging with lse = −inf is the identity, so the skip is exact);
+    ``steps_run`` counts the kernels this rank actually executed
+    (``rank + 1`` of ``n`` when causal — see examples/longctx_audit.py).
+    """
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
@@ -122,21 +139,44 @@ def _ring_flash_forward(q, k, v, axis_name, causal, block_q, block_k):
     varying = functools.partial(lax.pcast, axis_name=axis_name, to="varying")
     out = varying(jnp.zeros((b, s_local, h, d), jnp.float32))
     lse = varying(jnp.full((b, s_local, h), NEG_INF, jnp.float32))
+    steps = varying(jnp.zeros((), jnp.int32))
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def step(carry, i):
-        k, v, out, lse = carry
+    def attend(k, v, out, lse, steps, i):
         owner = (my - i) % n
-        o_i, lse_i = flash_attention_with_lse(
-            q, k, v, causal=causal, q_offset=my * s_local,
-            k_offset=owner * s_local, block_q=block_q, block_k=block_k)
-        out, lse = _merge_partial(out, lse, o_i, lse_i)
-        k = lax.ppermute(k, axis_name, perm)
-        v = lax.ppermute(v, axis_name, perm)
-        return (k, v, out, lse), None
 
-    (_, _, out, lse), _ = lax.scan(step, (k, v, out, lse), jnp.arange(n))
-    return out, lse
+        def run(ops):
+            k, v, out, lse = ops
+            o_i, lse_i = flash_attention_with_lse(
+                q, k, v, causal=causal, q_offset=my * s_local,
+                k_offset=owner * s_local, block_q=block_q, block_k=block_k)
+            return _merge_partial(out, lse, o_i, lse_i)
+
+        if not causal:
+            out, lse = run((k, v, out, lse))
+            return out, lse, steps + 1
+        # A block that originated at a later shard (owner > my) is entirely
+        # above the causal diagonal — skip the kernel launch and the merge.
+        needed = owner <= my
+        out, lse = lax.cond(needed, run, lambda ops: (ops[2], ops[3]),
+                            (k, v, out, lse))
+        return out, lse, steps + needed.astype(jnp.int32)
+
+    def step(carry, i):
+        k, v, out, lse, steps = carry
+        # Issue step i+1's ICI transfer BEFORE this step's kernel: the
+        # ppermute reads only the resident buffer, never this step's
+        # attention output (double buffering; audited structurally).
+        k_nxt = lax.ppermute(k, axis_name, perm)
+        v_nxt = lax.ppermute(v, axis_name, perm)
+        out, lse, steps = attend(k, v, out, lse, steps, i)
+        return (k_nxt, v_nxt, out, lse, steps), None
+
+    if n > 1:
+        (k, v, out, lse, steps), _ = lax.scan(
+            step, (k, v, out, lse, steps), jnp.arange(n - 1))
+    out, lse, steps = attend(k, v, out, lse, steps, n - 1)
+    return out, lse, steps
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -154,14 +194,25 @@ def ring_flash_attention(q, k, v, axis_name: str, causal: bool = True,
     locally while dk/dv ride the ring with their K/V blocks, so the
     cotangent pass is O(S_local·D) memory too (no O(S²) transient).
     """
-    out, _ = _ring_flash_forward(q, k, v, axis_name, causal, block_q,
-                                 block_k)
+    out, _, _ = _ring_flash_forward(q, k, v, axis_name, causal, block_q,
+                                    block_k)
     return out.astype(q.dtype)
 
 
+def ring_flash_attention_stats(q, k, v, axis_name: str, causal: bool = True,
+                               block_q: int = 512, block_k: int = 1024):
+    """Forward-only variant returning ``(out, steps_run)`` where
+    ``steps_run`` is the number of flash kernels this rank executed — the
+    causal step-skipping observability hook used by the structural audit
+    and the parity tests (expected ``rank + 1`` of ``n`` when causal)."""
+    out, _, steps = _ring_flash_forward(q, k, v, axis_name, causal, block_q,
+                                        block_k)
+    return out.astype(q.dtype), steps
+
+
 def _ring_flash_fwd(q, k, v, axis_name, causal, block_q, block_k):
-    out, lse = _ring_flash_forward(q, k, v, axis_name, causal, block_q,
-                                   block_k)
+    out, lse, _ = _ring_flash_forward(q, k, v, axis_name, causal, block_q,
+                                      block_k)
     return out.astype(q.dtype), (q, k, v, out, lse)
 
 
@@ -183,25 +234,47 @@ def _ring_flash_bwd(axis_name, causal, block_q, block_k, res, g):
     dv = varying(jnp.zeros(v.shape, jnp.float32))
     perm = [(i, (i + 1) % n) for i in range(n)]
 
+    def attend(k, v, dq, dk, dv, i):
+        owner = (my - i) % n
+
+        def run(ops):
+            k, v, dq, dk, dv = ops
+            dq_i, dk_i, dv_i = flash_attention_backward(
+                q, k, v, g, lse, delta, causal,
+                my * s_local, owner * s_local, block_q, block_k, interpret)
+            return (dq + dq_i.astype(jnp.float32),
+                    dk + dk_i.astype(jnp.float32),
+                    dv + dv_i.astype(jnp.float32))
+
+        if not causal:
+            return run((k, v, dq, dk, dv))
+        # Fully-masked block (owner > my): p ≡ 0, so dq/dk/dv partials are
+        # exactly zero — skip the two backward kernels entirely.
+        needed = owner <= my
+        return lax.cond(needed, run, lambda ops: (ops[2], ops[3], ops[4]),
+                        (k, v, dq, dk, dv))
+
     def step(carry, i):
         k, v, dk, dv, dq = carry
-        owner = (my - i) % n
-        dq_i, dk_i, dv_i = flash_attention_backward(
-            q, k, v, g, lse, delta, causal,
-            my * s_local, owner * s_local, block_q, block_k, interpret)
-        dq = dq + dq_i.astype(jnp.float32)
-        dk = dk + dk_i.astype(jnp.float32)
-        dv = dv + dv_i.astype(jnp.float32)
-        # dk/dv travel WITH their K/V blocks: after n rotations both the
-        # blocks and their accumulated gradients are home.
-        k = lax.ppermute(k, axis_name, perm)
-        v = lax.ppermute(v, axis_name, perm)
+        # Prefetch the next K/V block before this step's kernels — the
+        # transfer is independent of their outputs (double buffering).
+        k_nxt = lax.ppermute(k, axis_name, perm)
+        v_nxt = lax.ppermute(v, axis_name, perm)
+        dq, dk, dv = attend(k, v, dq, dk, dv, i)
+        # dk/dv travel WITH their K/V blocks: they accumulate this step's
+        # kernel output, so their rotation necessarily trails the compute.
         dk = lax.ppermute(dk, axis_name, perm)
         dv = lax.ppermute(dv, axis_name, perm)
-        return (k, v, dk, dv, dq), None
+        return (k_nxt, v_nxt, dk, dv, dq), None
 
-    (_, _, dk, dv, dq), _ = lax.scan(
-        step, (k, v, dk, dv, dq), jnp.arange(n))
+    if n > 1:
+        (k, v, dk, dv, dq), _ = lax.scan(
+            step, (k, v, dk, dv, dq), jnp.arange(n - 1))
+    dq, dk, dv = attend(k, v, dq, dk, dv, n - 1)
+    # The final rotation is dk/dv's n-th: it carries them home.  K/V rotate
+    # only n−1 times (the old code paid a wasted n-th ppermute pair).
+    dk = lax.ppermute(dk, axis_name, perm)
+    dv = lax.ppermute(dv, axis_name, perm)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
@@ -287,8 +360,7 @@ def _zigzag_flash_forward(q, k, v, axis_name, causal, block_q, block_k):
     q_offs = (r * c, (2 * n - 1 - r) * c)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def step(carry, i):
-        k, v, out0, lse0, out1, lse1 = carry
+    def attend(k, v, out0, lse0, out1, lse1, i):
         owner = (r - i) % n
         k_offs = (owner * c, (2 * n - 1 - owner) * c)
         k_halves = _zigzag_chunks(k, c)
@@ -302,12 +374,23 @@ def _zigzag_flash_forward(q, k, v, axis_name, causal, block_q, block_k):
                     block_q=block_q, block_k=block_k)
                 acc[qi][0], acc[qi][1] = _merge_partial(
                     acc[qi][0], acc[qi][1], o_p, lse_p)
-        k = lax.ppermute(k, axis_name, perm)
-        v = lax.ppermute(v, axis_name, perm)
-        return (k, v, acc[0][0], acc[0][1], acc[1][0], acc[1][1]), None
+        return acc[0][0], acc[0][1], acc[1][0], acc[1][1]
 
-    (_, _, out0, lse0, out1, lse1), _ = lax.scan(
-        step, (k, v, outs[0], lses[0], outs[1], lses[1]), jnp.arange(n))
+    def step(carry, i):
+        k, v, out0, lse0, out1, lse1 = carry
+        # Prefetch before the half-pair kernels (double buffering); masked
+        # half-pairs are already ~free via the kernel's diagonal bound.
+        k_nxt = lax.ppermute(k, axis_name, perm)
+        v_nxt = lax.ppermute(v, axis_name, perm)
+        out0, lse0, out1, lse1 = attend(k, v, out0, lse0, out1, lse1, i)
+        return (k_nxt, v_nxt, out0, lse0, out1, lse1), None
+
+    out0, lse0, out1, lse1 = outs[0], lses[0], outs[1], lses[1]
+    if n > 1:
+        (k, v, out0, lse0, out1, lse1), _ = lax.scan(
+            step, (k, v, out0, lse0, out1, lse1), jnp.arange(n - 1))
+    # Final step unrolled: no next block to fetch, no wasted rotation.
+    out0, lse0, out1, lse1 = attend(k, v, out0, lse0, out1, lse1, n - 1)
     return (jnp.concatenate([out0, out1], axis=1),
             jnp.concatenate([lse0, lse1], axis=1))
 
@@ -358,8 +441,9 @@ def _zigzag_bwd(axis_name, causal, block_q, block_k, res, g):
     q_offs = (r * c, (2 * n - 1 - r) * c)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def step(carry, i):
-        k, v, dk_halves, dv_halves, dq_halves = carry
+    rot = functools.partial(lax.ppermute, axis_name=axis_name, perm=perm)
+
+    def attend(k, v, dk_halves, dv_halves, dq_halves, i):
         dk_halves, dv_halves = list(dk_halves), list(dv_halves)
         dq_halves = list(dq_halves)
         owner = (r - i) % n
@@ -375,14 +459,29 @@ def _zigzag_bwd(axis_name, causal, block_q, block_k, res, g):
                 dq_halves[qi] = dq_halves[qi] + dq_p.astype(jnp.float32)
                 dk_halves[ki] = dk_halves[ki] + dk_p.astype(jnp.float32)
                 dv_halves[ki] = dv_halves[ki] + dv_p.astype(jnp.float32)
-        # dk/dv travel WITH their K/V blocks: after n rotations both the
-        # blocks and their accumulated gradients are home.
-        rot = functools.partial(lax.ppermute, axis_name=axis_name, perm=perm)
-        return (rot(k), rot(v), tuple(map(rot, dk_halves)),
-                tuple(map(rot, dv_halves)), tuple(dq_halves)), None
+        return tuple(dk_halves), tuple(dv_halves), tuple(dq_halves)
 
-    (_, _, dk_halves, dv_halves, dq_halves), _ = lax.scan(
-        step, (k, v, tuple(dks), tuple(dvs), tuple(dqs)), jnp.arange(n))
+    def step(carry, i):
+        k, v, dk_halves, dv_halves, dq_halves = carry
+        # Prefetch the next K/V block before this step's kernels — the
+        # transfer is independent of their outputs (double buffering).
+        k_nxt, v_nxt = rot(k), rot(v)
+        dk_halves, dv_halves, dq_halves = attend(
+            k, v, dk_halves, dv_halves, dq_halves, i)
+        # dk/dv travel WITH their K/V blocks: they accumulate this step's
+        # kernel output, so their rotation necessarily trails the compute.
+        return (k_nxt, v_nxt, tuple(map(rot, dk_halves)),
+                tuple(map(rot, dv_halves)), dq_halves), None
+
+    dk_halves, dv_halves, dq_halves = tuple(dks), tuple(dvs), tuple(dqs)
+    if n > 1:
+        (k, v, dk_halves, dv_halves, dq_halves), _ = lax.scan(
+            step, (k, v, dk_halves, dv_halves, dq_halves), jnp.arange(n - 1))
+    dk_halves, dv_halves, dq_halves = attend(
+        k, v, dk_halves, dv_halves, dq_halves, n - 1)
+    # dk/dv's n-th rotation carries them home; K/V rotate only n−1 times.
+    dk_halves = tuple(map(rot, dk_halves))
+    dv_halves = tuple(map(rot, dv_halves))
     cat = functools.partial(jnp.concatenate, axis=1)
     return (cat(dq_halves).astype(q.dtype), cat(dk_halves).astype(k.dtype),
             cat(dv_halves).astype(v.dtype))

@@ -133,6 +133,18 @@ aliases; the TPU-specific defaults differ where the hardware does:
   injectors (faults.py): ``"<rank>[:<nth>]"`` makes rank <rank>'s <nth>
   bulk send vanish, carry a flipped chunk CRC, or close mid-stream —
   exercising the fallback chain deterministically.
+* ``HVD_TPU_CTX_LAYOUT`` — long-context sequence layout override for
+  ``plan_context`` (``auto``/``plain``/``zigzag``; default ``auto``: causal
+  multi-shard workloads route to zigzag, everything else to plain).
+  Malformed values degrade to ``auto`` with a warning.
+* ``HVD_TPU_CTX_BLOCK_Q`` / ``HVD_TPU_CTX_BLOCK_K`` — pin the flash kernel
+  tile sizes the ContextPlan would otherwise derive (and VMEM-fit-clamp)
+  from the workload.  Overrides are still clamped to the VMEM budget —
+  the knob cannot reintroduce the r5 block_k=4096 S=32768 OOM.  Unset or
+  malformed: planner-derived.
+* ``HVD_TPU_CTX_REMAT`` — force the long-context remat policy (``1`` =
+  full-layer remat, ``0`` = none) instead of the planner's
+  activation-bytes-vs-headroom decision.  Unset: planner-decided.
 """
 
 from __future__ import annotations
@@ -468,3 +480,72 @@ def device_headroom_mb() -> float | None:
             f"(headroom stays unknown)", RuntimeWarning, stacklevel=2)
         return None
     return max(value, 0.0)
+
+
+_CTX_LAYOUTS = ("auto", "plain", "zigzag")
+
+
+def ctx_layout() -> str:
+    """``HVD_TPU_CTX_LAYOUT`` — long-context layout override consulted by
+    ``ops.schedule_plan.plan_context``: ``plain``/``zigzag`` pin the
+    sequence layout, ``auto`` (the default) lets the planner route causal
+    multi-shard workloads to zigzag.  Malformed values degrade to ``auto``
+    with a warning (launch-script typos must not fork the layout)."""
+    raw = _get("CTX_LAYOUT")
+    if raw in (None, ""):
+        return "auto"
+    value = raw.strip().lower()
+    if value in _CTX_LAYOUTS:
+        return value
+    import warnings
+
+    name = ("HOROVOD_CTX_LAYOUT" if "HOROVOD_CTX_LAYOUT" in os.environ
+            else "HVD_TPU_CTX_LAYOUT")
+    warnings.warn(
+        f"{name}={raw!r} is not one of {_CTX_LAYOUTS}; falling back to "
+        f"'auto'", RuntimeWarning, stacklevel=2)
+    return "auto"
+
+
+def _ctx_block(which: str) -> int | None:
+    raw = _get("CTX_BLOCK_" + which)
+    if raw in (None, ""):
+        return None
+    try:
+        value = int(raw)
+        if value <= 0:
+            raise ValueError("non-positive block")
+    except ValueError:
+        import warnings
+
+        name = ("HOROVOD_CTX_BLOCK_" + which
+                if "HOROVOD_CTX_BLOCK_" + which in os.environ
+                else "HVD_TPU_CTX_BLOCK_" + which)
+        warnings.warn(
+            f"{name}={raw!r} is not a positive integer; ignoring the "
+            f"override (planner-derived tile)", RuntimeWarning, stacklevel=3)
+        return None
+    return value
+
+
+def ctx_block_q() -> int | None:
+    """``HVD_TPU_CTX_BLOCK_Q`` — pin the ContextPlan's flash ``block_q``
+    (still VMEM-fit-clamped).  Unset/malformed: planner-derived."""
+    return _ctx_block("Q")
+
+
+def ctx_block_k() -> int | None:
+    """``HVD_TPU_CTX_BLOCK_K`` — pin the ContextPlan's flash ``block_k``
+    (still VMEM-fit-clamped, so the knob cannot reintroduce the r5
+    block_k=4096 S=32768 OOM).  Unset/malformed: planner-derived."""
+    return _ctx_block("K")
+
+
+def ctx_remat_override() -> bool | None:
+    """``HVD_TPU_CTX_REMAT`` — force the long-context remat policy (``1``
+    full-layer remat, ``0`` none) instead of the planner's
+    activation-vs-headroom decision.  Unset: None (planner-decided)."""
+    raw = _get("CTX_REMAT")
+    if raw in (None, ""):
+        return None
+    return raw not in ("0", "false", "False")

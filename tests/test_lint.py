@@ -351,6 +351,73 @@ def test_hvd107_suppressible_for_legacy_fixtures():
 
 
 # ---------------------------------------------------------------------------
+# HVD108 — hand-tuned context layout (the context planner owns it)
+# ---------------------------------------------------------------------------
+
+def test_hvd108_plain_causal_literal_and_default():
+    # causal=True literal AND causal-left-to-default both run causal work
+    # on the plain ring layout — the planner routes that to zigzag.
+    assert codes("""
+        from horovod_tpu.parallel import ring_flash_attention
+
+        def f(q, k, v, bq, bk):
+            a = ring_flash_attention(q, k, v, "sp", causal=True,
+                                     block_q=bq, block_k=bk)
+            b = ring_flash_attention(q, k, v, "sp", block_q=bq, block_k=bk)
+            return a, b
+    """) == ["HVD108", "HVD108"]
+
+
+def test_hvd108_block_literals_all_entry_points():
+    assert codes("""
+        from horovod_tpu.parallel import (
+            make_ring_flash_attention,
+            make_zigzag_ring_flash_attention,
+            ring_flash_attention,
+            zigzag_ring_flash_attention,
+        )
+
+        def f(q, k, v, causal):
+            a = ring_flash_attention(q, k, v, "sp", causal, 512, block_k=4096)
+            b = zigzag_ring_flash_attention(q, k, v, "sp", causal, block_q=256)
+            c = make_ring_flash_attention("sp", block_k=2048)
+            d = make_zigzag_ring_flash_attention("sp", 128)
+            return a, b, c, d
+    """) == ["HVD108"] * 5  # a fires twice (block_q positional + block_k)
+
+
+def test_hvd108_clean_planner_driven_sites():
+    # Variables — including plan fields — are the planner speaking;
+    # causal=False on the plain ring wastes nothing.  None of it fires.
+    assert codes("""
+        from horovod_tpu.parallel import (
+            ring_flash_attention,
+            zigzag_ring_flash_attention,
+        )
+
+        def f(q, k, v, plan, causal):
+            a = ring_flash_attention(q, k, v, "sp", causal,
+                                     plan.block_q, plan.block_k)
+            b = ring_flash_attention(q, k, v, "sp", causal=False)
+            c = zigzag_ring_flash_attention(q, k, v, "sp", True,
+                                            plan.block_q, plan.block_k)
+            return a, b, c
+    """) == []
+
+
+def test_hvd108_suppressible_for_audit_fixtures():
+    # The longctx audit pins the plain causal path on purpose (the
+    # step-skip contract is specific to it) — sanctioned, line by line.
+    assert codes("""
+        from horovod_tpu.parallel import ring_flash_attention
+
+        def f(q, k, v):
+            return ring_flash_attention(  # hvd-lint: disable=HVD108
+                q, k, v, "sp", True, block_q=4, block_k=4)
+    """) == []
+
+
+# ---------------------------------------------------------------------------
 # Suppression + driver behaviour
 # ---------------------------------------------------------------------------
 

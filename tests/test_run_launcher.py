@@ -203,25 +203,34 @@ def test_elastic_single_rank_relaunch_accounting_and_breaker_reset():
 
 
 def test_elastic_rank0_death_still_aborts_job():
-    """Coordinator failover is out of scope: rank 0 dying under --elastic
-    keeps the mpirun job-abort + full-restart contract."""
+    """Coordinator failover (PR 7): rank 0 dying under --elastic no longer
+    aborts the job — the standby promotes, the dead seat is relaunched
+    alone as a joiner, and the supervisor accounts it as a single-rank
+    relaunch rather than an mpirun-style full restart.  (Pre-PR-7 this
+    test asserted the job-abort + full-restart contract.)"""
     script = textwrap.dedent("""
         import os, sys, time
         rank = int(os.environ["JAX_PROCESS_ID"])
-        attempt = int(os.environ.get("HVD_TPU_RESTART_ATTEMPT", "0"))
-        if attempt > 0:
-            sys.exit(0)
-        if rank == 0:
+        joined = os.environ.get("HVD_TPU_ELASTIC_JOIN") == "1"
+        if rank == 0 and not joined:
             time.sleep(0.3)
             sys.exit(75)
-        time.sleep(120)
+        if rank == 0 and joined:
+            print("COORD_SEAT_REJOINED attempt="
+                  + os.environ.get("HVD_TPU_RESTART_ATTEMPT", "?"), flush=True)
+            sys.exit(0)
+        time.sleep(2.0)           # survivor keeps running through failover
+        sys.exit(0)
     """)
     res = _supervised(2, script, "--elastic", "--max-restarts", "1",
                       timeout=scaled(60))
     assert res.returncode == 0, res.stdout + res.stderr
-    assert "relaunching only rank" not in res.stderr, res.stderr
-    assert "restarting (attempt 1" in res.stderr, res.stderr
-    assert "supervisor summary: full_restarts=1" in res.stderr, res.stderr
+    # The job survives: rank 0's seat comes back alone, no job teardown.
+    assert "elastic mode: relaunching only rank 0" in res.stderr, res.stderr
+    assert "COORD_SEAT_REJOINED attempt=1" in res.stdout, res.stdout
+    assert "supervisor summary: full_restarts=0 single_rank_relaunches=1" \
+        in res.stderr, res.stderr
+    assert "restarting (attempt" not in res.stderr, res.stderr
 
 
 def test_sigterm_reaps_grandchildren():
