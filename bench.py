@@ -91,9 +91,27 @@ Two phases, one JSON metric line each:
    ``vs_baseline`` are null: the line then documents the PLAN (and that
    the wired path trains) rather than the throughput.
 
+2f. **Control-plane scaling** — the deviceless fleet simulator
+   (core/src/fleet_sim.cc: the real root/relay protocol code, scripted
+   member processes, thread-CPU busy accounting) measures the negotiated
+   coordination tick of the hierarchical tree at 4096 protocol-only
+   ranks against the rank-0 star at the reference's demonstrated
+   512-worker scale::
+
+       {"metric": "control_plane_tick_us", "value": N, "unit": "us",
+        "vs_baseline": <star_512_tick_us / value>, "p": 4096,
+        "topology": "tree", "fanout": F, "num_groups": G, "depth": 2,
+        "star_512_tick_us": M, "agg_frames_per_tick": G}
+
+   The acceptance bar is value < 5000 (one HOROVOD_CYCLE_TIME budget)
+   at depth >= 2 while the 512-star baseline already exceeds it
+   (docs/benchmarks.md "Control-plane scaling").  ``BENCH_CP_RANKS`` /
+   ``BENCH_CP_FANOUT`` / ``BENCH_CP_TICKS`` resize the run.
+
 ``BENCH_SKIP_EAGER=1`` / ``BENCH_SKIP_RESNET=1`` / ``BENCH_SKIP_PLAN=1``
 / ``BENCH_SKIP_CKPT=1`` / ``BENCH_SKIP_DATAPLANE=1`` /
-``BENCH_SKIP_LONGCTX=1`` skip individual phases.
+``BENCH_SKIP_LONGCTX=1`` / ``BENCH_SKIP_CONTROL_PLANE=1`` skip
+individual phases.
 
 3. **Fault-detection MTTR** (``bench.py --fault``) — two-process engine
    job; rank 1 is SIGKILLed at steady state and the survivor's
@@ -574,6 +592,54 @@ def dataplane_bench() -> None:
     }))
 
 
+def control_plane_bench() -> None:
+    """Tree-vs-star coordination-tick scaling via the fleet simulator.
+
+    Runs core/fleet_sim twice — the tree at ``BENCH_CP_RANKS`` (default
+    4096) protocol-only ranks and the star at 512, the reference's
+    demonstrated scale — and reports the tree's modeled per-tick busy
+    time with the star baseline as ``vs_baseline``.  The simulator runs
+    the REAL TreeRootPlane/Coordinator/relay code; only the members are
+    scripted, and busy time is thread CPU so one oversubscribed host
+    can stand in for a fleet (methodology disclosed in fleet_sim.cc and
+    docs/benchmarks.md)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    core = os.path.join(here, "horovod_tpu", "core")
+    binary = os.path.join(core, "fleet_sim")
+    if not os.path.exists(binary):
+        subprocess.run(["make", "-C", core, "fleet_sim"], check=True,
+                       capture_output=True)
+
+    def run(argv: list[str]) -> dict:
+        res = subprocess.run([binary] + argv, capture_output=True,
+                             text=True, timeout=900, check=True)
+        line = next(ln for ln in reversed(res.stdout.splitlines())
+                    if "modeled_tick_us" in ln)
+        return json.loads(line)
+
+    ranks = int(os.environ.get("BENCH_CP_RANKS", "4096"))
+    fanout = int(os.environ.get("BENCH_CP_FANOUT", "128"))
+    ticks = os.environ.get("BENCH_CP_TICKS", "12")
+    tree = run(["--p", str(ranks), "--fanout", str(fanout),
+                "--ticks", ticks])
+    star = run(["--p", "512", "--topology", "star", "--ticks", ticks])
+    assert tree["ok"] and star["ok"], (tree, star)
+    print(json.dumps({
+        "metric": "control_plane_tick_us",
+        "value": round(tree["modeled_tick_us"], 1),
+        "unit": "us",
+        "vs_baseline": round(star["modeled_tick_us"]
+                             / max(tree["modeled_tick_us"], 1e-9), 2),
+        "p": ranks,
+        "topology": "tree",
+        "fanout": fanout,
+        "num_groups": tree["num_groups"],
+        "depth": tree["depth"],
+        "star_512_tick_us": round(star["modeled_tick_us"], 1),
+        "agg_frames_per_tick": tree["agg_frames_per_tick"],
+    }))
+
+
 def overlap_plan_microbench() -> None:
     """Width-1 planner check, in the harness where the regression lived:
     lower a small training step over a ONE-device mesh and assert the
@@ -740,6 +806,8 @@ def main() -> None:
         checkpoint_bench()
     if os.environ.get("BENCH_SKIP_DATAPLANE") != "1":
         dataplane_bench()
+    if os.environ.get("BENCH_SKIP_CONTROL_PLANE") != "1":
+        control_plane_bench()
     if os.environ.get("BENCH_SKIP_LONGCTX") != "1":
         longctx_bench()
     if os.environ.get("BENCH_SKIP_RESNET") == "1":

@@ -37,7 +37,7 @@ _ATTR_HOME = {}
 for _mod, _names in {
     "horovod_tpu.basics": (
         "NotInitializedError", "cache_stats", "chips_per_slice",
-        "coord_state", "cross_rank",
+        "control_plane_stats", "coord_state", "cross_rank",
         "cross_size", "failure_report", "init", "is_initialized",
         "local_num_chips", "local_rank", "local_size", "member_process_ids",
         "mpi_threads_supported", "num_chips", "rank", "shutdown", "size",
@@ -83,8 +83,8 @@ _MODULE_ATTRS = {"profiling": "horovod_tpu.utils.profiling"}
 _SUBMODULES = frozenset({
     "basics", "callbacks", "checkpoint", "core", "data", "dataplane",
     "elastic", "faults", "flax", "keras", "mesh", "models", "ops",
-    "parallel", "replication", "run", "tensorflow", "torch", "training",
-    "utils",
+    "parallel", "relay", "replication", "run", "tensorflow", "torch",
+    "training", "tree", "utils",
 })
 
 # NOTE: __all__ deliberately excludes the lazy submodules — a star-import

@@ -415,6 +415,29 @@ def cache_stats() -> dict:
     return _engine.cache_stats()
 
 
+def control_plane_stats() -> dict:
+    """Control-plane topology and tick-latency stats for this rank's eager
+    engine (docs/benchmarks.md "Control-plane scaling")::
+
+        {"role": "tree_root", "depth": 2, "fanout": 64,
+         "tick_p50_ms": 0.8, "tick_p99_ms": 2.1,
+         "frames_per_tick": 64.0, "ticks": 1200, "frames_rx": 76800}
+
+    ``role`` names this rank's position in the control-plane topology
+    (``star_coordinator`` / ``star_worker`` below the tree threshold,
+    ``tree_root`` / ``tree_member`` above it, ``loopback`` single-process,
+    ``none`` before the eager engine starts).  ``tick_p50_ms`` /
+    ``tick_p99_ms`` are negotiated coordination-tick latencies over a
+    rolling window; ``frames_per_tick`` is the scaling number — O(groups)
+    on a tree root where the star coordinator pays O(size).  Each tick
+    also lands as a TICK instant on the Chrome timeline
+    (``HOROVOD_TIMELINE``)."""
+    _topo()
+    from horovod_tpu.core import engine as _engine
+
+    return _engine.control_plane_stats()
+
+
 def mpi_threads_supported() -> bool:
     """API-parity shim for reference common/__init__.py:147-154.
 

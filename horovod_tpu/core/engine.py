@@ -171,6 +171,18 @@ def _load_library() -> ctypes.CDLL:
     lib.hvd_coord_state.restype = ctypes.c_int
     lib.hvd_coord_state.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                     ctypes.c_int]
+    lib.hvd_control_plane_stats.restype = None
+    lib.hvd_control_plane_stats.argtypes = [ctypes.c_void_p,
+                                            ctypes.POINTER(ctypes.c_double)]
+    lib.hvd_tree_plan.restype = None
+    lib.hvd_tree_plan.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                                  ctypes.c_int, ctypes.POINTER(ctypes.c_int)]
+    lib.hvd_relay_run.restype = ctypes.c_int
+    lib.hvd_relay_run.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+                                  ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                                  ctypes.c_int, ctypes.c_longlong,
+                                  ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+                                  ctypes.c_longlong]
     lib.hvd_detach_listener.restype = None
     lib.hvd_detach_listener.argtypes = [ctypes.c_void_p]
     lib.hvd_poll.restype = ctypes.c_int
@@ -252,6 +264,11 @@ class ExecBatch:
             self.shapes.append(tuple(i64() for _ in range(nd)))
         ns = i32()
         self.first_dim_sizes = [i64() for _ in range(ns)]
+
+
+# Control-plane role codes (c_api.cc hvd_control_plane_stats).
+_CP_ROLES = {0: "loopback", 1: "star_coordinator", 2: "star_worker",
+             3: "tree_root", 4: "tree_member"}
 
 
 class NativeEngine:
@@ -691,6 +708,26 @@ class NativeEngine:
                 "verify_checked": verify_checked, "verify_tick": verify_tick,
                 "lru_order": lru_order}
 
+    def control_plane_stats(self) -> dict:
+        """Control-plane topology and tick-latency view for this rank
+        (docs/benchmarks.md "Control-plane scaling")::
+
+            {"role": "tree_root", "depth": 2, "fanout": 64,
+             "tick_p50_ms": 0.8, "tick_p99_ms": 2.1,
+             "frames_per_tick": 64.0, "ticks": 1200, "frames_rx": 76800}
+
+        ``frames_per_tick`` is the load-bearing scaling number: on a tree
+        root it equals the number of aggregator groups (O(fanout), pinned
+        by tests/test_tree.py), not the worker count."""
+        out = (ctypes.c_double * 8)()
+        self._lib.hvd_control_plane_stats(self._ptr, out)
+        role = int(out[0])
+        return {"role": _CP_ROLES.get(role, str(role)),
+                "depth": int(out[1]), "fanout": int(out[2]),
+                "tick_p50_ms": out[3], "tick_p99_ms": out[4],
+                "frames_per_tick": out[5], "ticks": int(out[6]),
+                "frames_rx": int(out[7])}
+
     def detach_listener(self) -> None:
         """Coordinator, reconfiguration hand-off: release the control-plane
         listen port for the re-formed membership while this stopped
@@ -902,6 +939,19 @@ def cache_stats() -> dict[str, int]:
         return {"hits": 0, "misses": 0, "evictions": 0, "bypassed_ticks": 0,
                 "entries": 0, "capacity": 0}
     return eng.cache_stats()
+
+
+def control_plane_stats() -> dict:
+    """Module-level control-plane stats; the ``"none"`` role with zeroed
+    counters when the engine was never started (the compiled SPMD path
+    has no control plane to measure)."""
+    with _engine_lock:
+        eng = _engine
+    if eng is None:
+        return {"role": "none", "depth": 0, "fanout": 0, "tick_p50_ms": 0.0,
+                "tick_p99_ms": 0.0, "frames_per_tick": 0.0, "ticks": 0,
+                "frames_rx": 0}
+    return eng.control_plane_stats()
 
 
 def failure_report() -> dict | None:

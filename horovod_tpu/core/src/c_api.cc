@@ -11,6 +11,7 @@
 
 #include "engine.h"
 #include "half.h"
+#include "tree.h"
 
 using hvd::DataType;
 using hvd::Engine;
@@ -105,6 +106,22 @@ void* hvd_create(int rank, int size, double cycle_ms,
   opts.reconfig_timeout_ms =
       EnvMs("HOROVOD_RECONFIG_TIMEOUT_MS", "HVD_TPU_RECONFIG_TIMEOUT_MS",
             opts.reconfig_timeout_ms);
+  // Hierarchical coordinator tree (tree.h; docs/benchmarks.md
+  // "Control-plane scaling").  Pure control-plane topology tuning — rides
+  // the environment like the heartbeat knobs; documented in utils/env.py.
+  opts.tree_enable =
+      EnvFlag("HOROVOD_TREE_ENABLE", "HVD_TPU_TREE_ENABLE") ? 1 : 0;
+  // Defaults mirror utils/env.py tree_fanout()/tree_threshold() — the plan
+  // must be the same pure function of the same knobs on every rank AND in
+  // the launcher that places the relay sidecars.
+  opts.tree_fanout = static_cast<int>(
+      EnvMs("HOROVOD_TREE_FANOUT", "HVD_TPU_TREE_FANOUT", 64));
+  opts.tree_threshold = static_cast<int>(
+      EnvMs("HOROVOD_TREE_THRESHOLD", "HVD_TPU_TREE_THRESHOLD", 256));
+  opts.tree_exchange_timeout_ms = static_cast<long long>(
+      EnvMs("HOROVOD_TREE_EXCHANGE_TIMEOUT_MS",
+            "HVD_TPU_TREE_EXCHANGE_TIMEOUT_MS",
+            static_cast<double>(opts.tree_exchange_timeout_ms)));
   return new Engine(std::move(opts));
 }
 
@@ -216,6 +233,62 @@ void hvd_cache_stats(void* e, long long* out) {
   out[3] = static_cast<long long>(v.stats.bypassed_ticks);
   out[4] = static_cast<long long>(v.entries);
   out[5] = static_cast<long long>(v.capacity);
+}
+
+// Control-plane observability (docs/benchmarks.md "Control-plane
+// scaling"): fills out[0..7] with {role, depth, fanout, tick_p50_ms,
+// tick_p99_ms, frames_per_tick, ticks, frames_rx}.  Role codes:
+// 0 loopback, 1 star coordinator, 2 star worker, 3 tree root,
+// 4 tree member.
+void hvd_control_plane_stats(void* e, double* out) {
+  auto v = static_cast<Engine*>(e)->ControlPlaneStats();
+  out[0] = static_cast<double>(v.role);
+  out[1] = static_cast<double>(v.depth);
+  out[2] = static_cast<double>(v.fanout);
+  out[3] = v.tick_p50_ms;
+  out[4] = v.tick_p99_ms;
+  out[5] = v.frames_per_tick;
+  out[6] = static_cast<double>(v.ticks);
+  out[7] = static_cast<double>(v.frames_rx);
+}
+
+// Topology plan introspection (tree.py mirrors this for the launcher; the
+// parity is pinned by tests/test_tree.py): fills out[0..3] with {active,
+// fanout, num_groups, depth} for the given knobs.
+void hvd_tree_plan(int size, int fanout, int threshold, int enable,
+                   int* out) {
+  hvd::TreePlan p = hvd::PlanTree(size, fanout, threshold, enable);
+  out[0] = p.active ? 1 : 0;
+  out[1] = p.fanout;
+  out[2] = p.num_groups;
+  out[3] = p.depth;
+}
+
+// Run an aggregator relay (python -m horovod_tpu.relay sidecar).  BLOCKS
+// until the relay exits; returns its exit code (0 clean shutdown,
+// 1 escalated failure, 2 invalid configuration).
+int hvd_relay_run(int agg_id, const char* parent_host, int parent_port,
+                  int listen_port, int size, int fanout, int threshold,
+                  long long epoch, int standby, const char* peer_host,
+                  int peer_port, long long member_timeout_ms) {
+  hvd::RelayOptions opt;
+  opt.agg_id = agg_id;
+  if (parent_host != nullptr && *parent_host != '\0') {
+    opt.parent_host = parent_host;
+  }
+  opt.parent_port = parent_port;
+  opt.listen_port = listen_port;
+  opt.size = size;
+  opt.fanout = fanout;
+  opt.threshold = threshold;
+  opt.epoch = epoch;
+  opt.standby = standby != 0;
+  if (peer_host != nullptr) opt.peer_host = peer_host;
+  opt.peer_port = peer_port;
+  if (member_timeout_ms > 0) opt.member_timeout_ms = member_timeout_ms;
+  opt.heartbeat_ms = static_cast<long long>(
+      EnvMs("HOROVOD_HEARTBEAT_MS", "HVD_TPU_HEARTBEAT_MS", 250.0));
+  return hvd::RunRelay(opt);
 }
 
 // Schedule-verifier intake (analysis/schedule.py): one call per collective

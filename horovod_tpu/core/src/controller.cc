@@ -1,5 +1,7 @@
 #include "controller.h"
 
+#include "wire.h"
+
 #include <arpa/inet.h>
 #include <fcntl.h>
 #include <netinet/in.h>
@@ -23,9 +25,9 @@ namespace hvd {
 // TCP framing helpers
 // ---------------------------------------------------------------------------
 
-namespace {
-
-constexpr size_t kMaxFrameBytes = 64u << 20;  // 64 MiB sanity cap
+// Definitions for the shared helpers declared in wire.h (the tree planes
+// in tree.cc speak the same frames from more vantage points).
+namespace wire {
 
 bool SendAll(int fd, const void* buf, size_t n) {
   const char* p = static_cast<const char*>(buf);
@@ -54,8 +56,6 @@ bool RecvAll(int fd, void* buf, size_t n) {
 // Blocking read that stays interruptible: polls in bounded slices so a
 // failure recorded by the monitor thread (heartbeat timeout, send error)
 // breaks a read that would otherwise block on a dead peer forever.
-enum class RecvResult { OK, CLOSED, FAILED, INTERRUPTED };
-
 RecvResult RecvSome(int fd, void* buf, size_t n,
                     const std::atomic<bool>& stop, size_t* got_out) {
   char* p = static_cast<char*>(buf);
@@ -150,30 +150,26 @@ double RendezvousBudgetSeconds() {
   return 300.0;
 }
 
-// Bounded exponential backoff with jitter — the C++ mirror of
-// horovod_tpu/utils/backoff.py (one retry policy across the stack).
-// Replaces the old fixed 100 ms connect sleep: N workers restarting
-// together decorrelate instead of hammering the coordinator in lockstep.
-struct Backoff {
-  double initial_s;
-  double max_s;
-  unsigned seed;
-  double DelaySeconds(int attempt) {
-    double base = initial_s;
-    for (int k = 0; k < attempt && base < max_s; ++k) base *= 2.0;
-    if (base > max_s) base = max_s;
-    double u = static_cast<double>(rand_r(&seed)) / RAND_MAX;
-    return base / 2.0 + u * (base / 2.0);
-  }
-  void Sleep(int attempt, double budget_left_s) {
-    double d = DelaySeconds(attempt);
-    if (d > budget_left_s) d = budget_left_s;
-    if (d <= 0) return;
-    ::usleep(static_cast<useconds_t>(d * 1e6));
-  }
-};
+long long ThreadCpuMicros() {
+  timespec ts;
+  if (::clock_gettime(CLOCK_THREAD_CPUTIME_ID, &ts) != 0) return 0;
+  return static_cast<long long>(ts.tv_sec) * 1000000LL + ts.tv_nsec / 1000;
+}
 
-}  // namespace
+}  // namespace wire
+
+// Backoff replaces the old fixed 100 ms connect sleep: N workers
+// restarting together decorrelate instead of hammering the coordinator in
+// lockstep (struct now lives in wire.h for the tree planes).
+using wire::Backoff;
+using wire::kMaxFrameBytes;
+using wire::ParseWireFaultEnv;
+using wire::RecvResult;
+using wire::RecvSome;
+using wire::RecvAll;
+using wire::RendezvousBudgetSeconds;
+using wire::SendAll;
+using wire::WireVersionFromEnv;
 
 // ---------------------------------------------------------------------------
 // TcpControlPlane
@@ -191,10 +187,13 @@ int TcpControlPlane::BindListener(int* port, std::string* err) {
   addr.sin_family = AF_INET;
   addr.sin_addr.s_addr = htonl(INADDR_ANY);
   addr.sin_port = htons(static_cast<uint16_t>(*port));
-  // Backlog sized for the failover window: every survivor's re-rendezvous
-  // connect can park here before the promoted standby starts accepting.
+  // Backlog sized for the failover window (every survivor's re-rendezvous
+  // connect can park here before the promoted standby starts accepting) and
+  // for the fleet simulator's thundering-herd rendezvous, where thousands of
+  // protocol-only members connect in one burst.  The kernel clamps to
+  // net.core.somaxconn, so the large ask is safe everywhere.
   if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
-      ::listen(fd, 64) != 0) {
+      ::listen(fd, 4096) != 0) {
     *err = "bind/listen failed on port " + std::to_string(*port);
     ::close(fd);
     return -1;
@@ -1060,6 +1059,7 @@ bool TcpControlPlane::RecvDataFrame(int fd, int peer_rank, FrameType expect,
     }
     if (PartitionActive()) continue;  // simulated partition: nothing lands
     NoteRx(peer_rank);
+    frames_rx_.fetch_add(1, std::memory_order_relaxed);
     FrameType t = static_cast<FrameType>(h.type);
     if (t == FrameType::HEARTBEAT) continue;
     if (t == FrameType::STANDBY) {
@@ -1300,8 +1300,29 @@ bool TcpControlPlane::Exchange(const RequestList& send, ResponseList* recv) {
   return true;
 }
 
+namespace {
+// Accumulates wall time minus declared waits into an atomic on scope exit —
+// the "busy" component of a Gather/Broadcast that the fleet simulator
+// composes into a modeled tick (poll() idle time is the members' think
+// time, not coordinator work).
+// Thread-CPU busy accounting: a blocking poll()/recv() consumes no CPU,
+// so BusyMicros() reads as pure protocol work even when the host is
+// oversubscribed (the fleet simulator runs hundreds of protocol
+// processes on one core — wall-minus-waits there measures the scheduler,
+// not the plane).
+struct BusyScope {
+  std::atomic<long long>& acc;
+  long long c0 = wire::ThreadCpuMicros();
+  ~BusyScope() {
+    long long el = wire::ThreadCpuMicros() - c0;
+    if (el > 0) acc.fetch_add(el, std::memory_order_relaxed);
+  }
+};
+}  // namespace
+
 bool TcpControlPlane::Gather(const RequestList& own,
                              std::vector<RequestList>* all) {
+  BusyScope busy{busy_us_};
   // poll()-driven interleaved reads (round 5): the old loop recv'd
   // workers sequentially in fd order, so at large P a tick cost the SUM
   // of per-worker arrival latencies — measured past the 5 ms cycle
@@ -1456,6 +1477,7 @@ bool TcpControlPlane::Gather(const RequestList& own,
           continue;
         }
         NoteRx(wrank);
+        frames_rx_.fetch_add(1, std::memory_order_relaxed);
         if (t == FrameType::HEARTBEAT) {
           f = FrameState{};  // liveness only; keep draining this fd
           continue;
@@ -1498,6 +1520,7 @@ bool TcpControlPlane::Gather(const RequestList& own,
 }
 
 bool TcpControlPlane::Broadcast(const ResponseList& out) {
+  BusyScope busy{busy_us_};
   std::string payload;
   Serialize(out, &payload);
   for (size_t i = 0; i < worker_fds_.size(); ++i) {

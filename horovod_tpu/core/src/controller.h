@@ -118,6 +118,15 @@ class ControlPlane {
   virtual bool RequestTicket(const TicketRequest& /*req*/) { return false; }
   virtual bool PollTicket(Ticket* /*out*/) { return false; }
   virtual void RequeueTicket(Ticket&& /*ticket*/) {}
+
+  // Observability (hvd.control_plane_stats()): completed inbound frames
+  // since the plane came up (heartbeats included) and microseconds spent
+  // actually processing frames (poll()-wait excluded).  The frame counter
+  // feeds the per-tick frame rate; the busy counter is what the fleet
+  // simulator composes into a modeled tick on a single host, where
+  // wall-clock would measure the scheduler instead of the protocol.
+  virtual long long FramesReceived() const { return 0; }
+  virtual long long BusyMicros() const { return 0; }
 };
 
 // Single-process transport: Exchange/Gather/Broadcast are pass-throughs.
@@ -204,6 +213,13 @@ class TcpControlPlane : public ControlPlane {
   bool RequestTicket(const TicketRequest& req) override;
   bool PollTicket(Ticket* out) override;
   void RequeueTicket(Ticket&& ticket) override;
+
+  long long FramesReceived() const override {
+    return frames_rx_.load(std::memory_order_relaxed);
+  }
+  long long BusyMicros() const override {
+    return busy_us_.load(std::memory_order_relaxed);
+  }
   // Worker: port of the pre-bound succession listener (0 = none).  The
   // engine surfaces it as the elastic worker's bound_port so Python can
   // re-bind the same endpoint when this rank is promoted.
@@ -313,6 +329,8 @@ class TcpControlPlane : public ControlPlane {
   uint8_t wire_version_ = kWireVersion;  // HVD_TPU_WIRE_VERSION override
   WireFaultSpec fault_;
   std::atomic<long long> frames_sent_{0};
+  std::atomic<long long> frames_rx_{0};  // completed inbound frames
+  std::atomic<long long> busy_us_{0};    // Gather/Broadcast work, waits excluded
   std::atomic<bool> corrupt_fired_{false};
   std::atomic<bool> halfclosed_{false};
 };

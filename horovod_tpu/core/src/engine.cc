@@ -6,6 +6,8 @@
 #include <cstdlib>
 #include <sstream>
 
+#include "tree.h"
+
 namespace hvd {
 
 namespace {
@@ -42,8 +44,51 @@ Status Engine::Start(int* bound_port) {
   if (!opts_.timeline_path.empty() && opts_.rank == 0) {
     timeline_.Initialize(opts_.timeline_path);
   }
+  // Hierarchical tree topology: a pure function of the (symmetric) knobs
+  // plus the launcher-wired HVD_TPU_TREE_AGG_MAP — every rank computes the
+  // identical answer, so star/tree can never disagree across the job.
+  TreePlan tree_plan = PlanTree(opts_.size, opts_.tree_fanout,
+                                opts_.tree_threshold, opts_.tree_enable);
+  std::vector<std::pair<TreeEndpoint, TreeEndpoint>> agg_map;
+  if (tree_plan.active) {
+    const char* spec = std::getenv("HVD_TPU_TREE_AGG_MAP");
+    if (spec == nullptr || *spec == '\0') {
+      // Enabled but not wired (no relay sidecars): fall back to the star.
+      tree_plan = TreePlan{};
+      tree_plan.size = opts_.size;
+    } else if (!ParseAggMap(spec, tree_plan.num_groups, &agg_map)) {
+      return Status::InvalidArgument(
+          "control plane: HVD_TPU_TREE_AGG_MAP is malformed or missing a "
+          "group (need one 'g=host:port[|host:port]' entry per aggregator "
+          "group; " + std::to_string(tree_plan.num_groups) + " groups)");
+    }
+  }
+  cp_depth_ = tree_plan.depth;
+  cp_fanout_ = tree_plan.active ? tree_plan.fanout : 0;
   if (opts_.size <= 1) {
     control_ = std::make_unique<LoopbackControlPlane>();
+    cp_role_ = 0;
+  } else if (tree_plan.active && opts_.rank == 0) {
+    std::string err;
+    auto cp = TreeRootPlane::Make(opts_.coordinator_port, opts_.size,
+                                  opts_.epoch, tree_plan, &err);
+    if (!cp) return Status::Unknown("control plane: " + err);
+    if (bound_port != nullptr) *bound_port = cp->bound_port();
+    control_ = std::move(cp);
+    cp_role_ = 3;
+  } else if (tree_plan.active) {
+    std::string err;
+    int g = TreeGroupOf(opts_.rank, tree_plan);
+    auto cp = TreeMemberPlane::Make(
+        agg_map[static_cast<size_t>(g)].first,
+        agg_map[static_cast<size_t>(g)].second, opts_.rank, opts_.epoch,
+        opts_.tree_exchange_timeout_ms, &err);
+    if (!cp) return Status::Unknown("control plane: " + err);
+    // Tree members have no succession listener — root failover is the
+    // star's mechanism (tree mode's elastic path re-forms as a star).
+    if (bound_port != nullptr) *bound_port = 0;
+    control_ = std::move(cp);
+    cp_role_ = 4;
   } else if (opts_.rank == 0) {
     std::string err;
     auto cp = TcpControlPlane::MakeCoordinator(opts_.coordinator_port,
@@ -52,6 +97,7 @@ Status Engine::Start(int* bound_port) {
     if (!cp) return Status::Unknown("control plane: " + err);
     if (bound_port != nullptr) *bound_port = cp->bound_port();
     control_ = std::move(cp);
+    cp_role_ = 1;
   } else {
     std::string err;
     // Elastic workers pre-bind a succession listener (standby=true): its
@@ -64,6 +110,7 @@ Status Engine::Start(int* bound_port) {
     if (!cp) return Status::Unknown("control plane: " + err);
     if (bound_port != nullptr) *bound_port = cp->standby_listen_port();
     control_ = std::move(cp);
+    cp_role_ = 2;
   }
   if (opts_.cache_capacity > 0) {
     cache_.SetCapacity(static_cast<size_t>(opts_.cache_capacity));
@@ -204,6 +251,7 @@ void Engine::RunCycle() {
   }
   own.shutdown = shutdown_requested_.load();
 
+  auto tick_t0 = std::chrono::steady_clock::now();
   ResponseList responses;
   if (control_->is_coordinator()) {
     std::vector<RequestList> gathered;
@@ -261,6 +309,28 @@ void Engine::RunCycle() {
     if (!control_->Exchange(own, &responses)) {
       HandleTransportFailure("control plane exchange failed");
       return;
+    }
+  }
+
+  {
+    // Negotiated-tick latency: the transport round (gather + negotiate +
+    // broadcast on the root; exchange on workers/members), excluding the
+    // local dispatch work below.  hvd.control_plane_stats() reads the ring.
+    long long us = std::chrono::duration_cast<std::chrono::microseconds>(
+                       std::chrono::steady_clock::now() - tick_t0)
+                       .count();
+    std::lock_guard<std::mutex> l(mu_);
+    if (tick_ring_.size() < 512) {
+      tick_ring_.push_back(us);
+    } else {
+      tick_ring_[tick_ring_pos_] = us;
+    }
+    tick_ring_pos_ = (tick_ring_pos_ + 1) % 512;
+    ++tick_count_;
+    if (timeline_.Initialized() && !responses.responses.empty()) {
+      // Tick marker on its own timeline row: lines up negotiation rounds
+      // against per-tensor NEGOTIATED/CACHE_HIT instants.
+      timeline_.Instant("control_plane", "TICK");
     }
   }
 
@@ -955,6 +1025,34 @@ Engine::CacheStatsView Engine::CacheStats() {
   v.stats = cache_.stats;
   v.entries = cache_.size();
   v.capacity = cache_.capacity();
+  return v;
+}
+
+Engine::ControlPlaneStatsView Engine::ControlPlaneStats() {
+  ControlPlaneStatsView v;
+  v.role = cp_role_;
+  v.depth = cp_depth_;
+  v.fanout = cp_fanout_;
+  std::vector<long long> window;
+  {
+    std::lock_guard<std::mutex> l(mu_);
+    v.ticks = tick_count_;
+    window = tick_ring_;
+  }
+  if (control_) v.frames_rx = control_->FramesReceived();
+  if (v.ticks > 0) {
+    v.frames_per_tick =
+        static_cast<double>(v.frames_rx) / static_cast<double>(v.ticks);
+  }
+  if (!window.empty()) {
+    std::sort(window.begin(), window.end());
+    auto at = [&](double q) {
+      size_t idx = static_cast<size_t>(q * (window.size() - 1) + 0.5);
+      return static_cast<double>(window[idx]) / 1000.0;
+    };
+    v.tick_p50_ms = at(0.50);
+    v.tick_p99_ms = at(0.99);
+  }
   return v;
 }
 

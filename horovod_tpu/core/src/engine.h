@@ -111,6 +111,16 @@ struct EngineOptions {
   // data plane): advertised in HELLO so the coordinator can issue
   // rank-to-rank transfer tickets naming this endpoint.
   int bulk_listen_port = 0;
+  // Hierarchical coordinator tree (tree.h; HVD_TPU_TREE_{ENABLE,FANOUT,
+  // THRESHOLD}, docs/benchmarks.md "Control-plane scaling").  The tree
+  // activates only when PlanTree says so AND HVD_TPU_TREE_AGG_MAP names an
+  // aggregator endpoint per group — all pure functions of the environment,
+  // so every rank picks the same topology with no negotiation.  Below the
+  // threshold the star plane is used bit-for-bit unchanged.
+  int tree_enable = 0;
+  int tree_fanout = 0;
+  int tree_threshold = 0;
+  long long tree_exchange_timeout_ms = 10000;
 };
 
 class Engine {
@@ -162,6 +172,26 @@ class Engine {
     uint64_t capacity = 0;
   };
   CacheStatsView CacheStats();
+
+  // Control-plane observability (hvd.control_plane_stats() in Python;
+  // docs/benchmarks.md "Control-plane scaling").  Negotiated-tick latency
+  // percentiles over a rolling window of completed cycles, inbound frame
+  // totals from the plane (heartbeats included), and this rank's topology
+  // role, so a 4096-rank operator can see where a slow tick's time goes
+  // without attaching a profiler to rank 0.
+  struct ControlPlaneStatsView {
+    // 0 = loopback, 1 = star coordinator, 2 = star worker,
+    // 3 = tree root, 4 = tree member.
+    int role = 0;
+    int depth = 1;    // frame hops member -> root (star: 1, tree: 2)
+    int fanout = 0;   // 0 when the star plane is active
+    double tick_p50_ms = 0;
+    double tick_p99_ms = 0;
+    double frames_per_tick = 0;  // cumulative frames_rx / completed ticks
+    long long ticks = 0;         // completed negotiation cycles
+    long long frames_rx = 0;     // completed inbound frames since Start
+  };
+  ControlPlaneStatsView ControlPlaneStats();
 
   // Schedule verifier intake: the Python layer reports each collective
   // submission's (seq, rolling hash, description); forwarded to the
@@ -329,6 +359,14 @@ class Engine {
   };
   std::unordered_map<int64_t, HandleState> handles_;
   std::vector<StallEntry> last_stall_;  // guarded by mu_
+  // Rolling negotiated-tick durations (µs) for control_plane_stats();
+  // guarded by mu_.  512 cycles ≈ 2.5 s of history at the default tick.
+  std::vector<long long> tick_ring_;
+  size_t tick_ring_pos_ = 0;
+  long long tick_count_ = 0;
+  int cp_role_ = 0;     // ControlPlaneStatsView role code
+  int cp_depth_ = 1;    // topology depth for stats
+  int cp_fanout_ = 0;   // topology fanout for stats
   std::vector<VerifyEntry> pending_verify_;      // guarded by mu_
   std::vector<DivergenceEntry> divergence_;      // guarded by mu_
   PeerFailureReport failure_;                    // guarded by mu_

@@ -492,6 +492,105 @@ bool Deserialize(const char* data, size_t len, Ticket* out) {
   return !r.fail;
 }
 
+void Serialize(const AggRequestList& in, std::string* out) {
+  Writer w{out};
+  w.i32(in.agg_id);
+  w.i64(in.seq);
+  w.i32(static_cast<int32_t>(in.members.size()));
+  for (int32_t m : in.members) w.i32(m);
+  // Subtree-intersected cache bits, encoded like RequestList.cache_hits.
+  int32_t max_bit = -1;
+  for (auto b : in.hits_all) max_bit = std::max(max_bit, b);
+  int32_t nbytes = (max_bit + 8) / 8;
+  w.i32(nbytes);
+  if (nbytes > 0) {
+    std::string bits(static_cast<size_t>(nbytes), '\0');
+    for (auto b : in.hits_all) {
+      if (b >= 0) {
+        bits[static_cast<size_t>(b) / 8] |= static_cast<char>(1 << (b % 8));
+      }
+    }
+    w.raw(bits.data(), bits.size());
+  }
+  w.u8(in.verify_folded ? 1 : 0);
+  if (in.verify_folded) {
+    w.i32(static_cast<int32_t>(in.verify_all.size()));
+    for (const auto& v : in.verify_all) {
+      w.i64(v.seq);
+      w.u64(v.hash);
+      w.str(v.desc);
+    }
+  }
+  // Per-member residuals as nested length-prefixed RequestList blobs.
+  for (size_t i = 0; i < in.members.size(); ++i) {
+    std::string blob;
+    if (i < in.residual.size()) Serialize(in.residual[i], &blob);
+    else Serialize(RequestList{}, &blob);
+    w.str(blob);
+  }
+}
+
+bool Deserialize(const char* data, size_t len, AggRequestList* out) {
+  Reader r{data, len};
+  out->agg_id = r.i32();
+  out->seq = r.i64();
+  int32_t n = r.i32();
+  if (r.fail || n < 0 || static_cast<size_t>(n) > kMaxVector) return false;
+  out->members.resize(n);
+  for (int32_t i = 0; i < n; ++i) out->members[i] = r.i32();
+  int32_t nbytes = r.i32();
+  if (r.fail || nbytes < 0 || static_cast<size_t>(nbytes) > kMaxVector) {
+    return false;
+  }
+  out->hits_all.clear();
+  for (int32_t byte = 0; byte < nbytes; ++byte) {
+    uint8_t v = r.u8();
+    for (int bit = 0; bit < 8; ++bit) {
+      if (v & (1u << bit)) out->hits_all.push_back(byte * 8 + bit);
+    }
+  }
+  out->verify_folded = r.u8() != 0;
+  out->verify_all.clear();
+  if (out->verify_folded) {
+    int32_t nv = r.i32();
+    if (r.fail || nv < 0 || static_cast<size_t>(nv) > kMaxVector) return false;
+    out->verify_all.reserve(nv);
+    for (int32_t i = 0; i < nv; ++i) {
+      VerifyEntry v;
+      v.seq = r.i64();
+      v.hash = r.u64();
+      v.desc = r.str();
+      if (r.fail) return false;
+      out->verify_all.push_back(std::move(v));
+    }
+  }
+  out->residual.assign(static_cast<size_t>(n), RequestList{});
+  for (int32_t i = 0; i < n; ++i) {
+    std::string blob = r.str();
+    if (r.fail) return false;
+    if (!Deserialize(blob.data(), blob.size(), &out->residual[i])) {
+      return false;
+    }
+  }
+  return !r.fail;
+}
+
+void Serialize(const AggState& in, std::string* out) {
+  Writer w{out};
+  w.i64(in.seq);
+  w.i64(static_cast<int64_t>(in.response.size()));
+  w.raw(in.response.data(), in.response.size());
+}
+
+bool Deserialize(const char* data, size_t len, AggState* out) {
+  Reader r{data, len};
+  out->seq = r.i64();
+  int64_t n = r.i64();
+  if (r.fail || n < 0 || static_cast<size_t>(n) > r.left) return false;
+  out->response.assign(r.p, static_cast<size_t>(n));
+  return true;
+}
+
 uint64_t BulkToken(int64_t transfer_id, int64_t epoch, int32_t src_rank,
                    int32_t dst_rank) {
   // splitmix64-style avalanche over the public tuple; NOT a secret — it

@@ -179,6 +179,15 @@ enum class FrameType : uint8_t {
   TICKET = 15,      // Ticket: the coordinator's authorization — the dst
                     // endpoint plus a transfer id/token the receiver can
                     // validate without ever seeing the ticket itself
+  AGG_REQUEST = 16,  // AggRequestList: one aggregator's combined subtree
+                     // frame — cache bits intersected, verifier hashes
+                     // folded, per-member residual requests — sent up the
+                     // coordinator tree once per tick
+                     // (docs/fault_tolerance.md "Hierarchical tree")
+  AGG_STATE = 17,    // AggState: aggregator -> its standby, the last
+                     // completed tick's {seq, ResponseList bytes} so a
+                     // promoted standby can replay the response to members
+                     // the dead primary never reached
 };
 
 // 16-byte little-endian header preceding every frame payload.  ``flags``
@@ -373,5 +382,50 @@ bool Deserialize(const char* data, size_t len, Ticket* out);
 // src, dst) tuple.  Mirrored bit-for-bit in Python (dataplane._token).
 uint64_t BulkToken(int64_t transfer_id, int64_t epoch, int32_t src_rank,
                    int32_t dst_rank);
+
+// One aggregator's combined per-tick frame (docs/fault_tolerance.md
+// "Hierarchical coordinator tree").  What today floods rank 0 as `fanout`
+// individual REQUEST frames is folded into one:
+//  * hits_all — cache bits announced by EVERY member this tick (the
+//    subtree intersection; the root bumps each member's readiness for
+//    them without seeing per-member bit vectors),
+//  * verify_folded/verify_all — the schedule-verifier entries, folded
+//    when every member reported an identical vector (the steady state:
+//    matching rolling hashes are the *point* of the verifier),
+//  * residual — the per-member leftovers (full requests, invalidations,
+//    partially-announced bits, shutdown flags) that are NOT common across
+//    the subtree and must reach the coordinator verbatim.
+// Combining is associative: a mid-tier aggregator can merge child
+// AggRequestLists the same way, so depth-3 trees need no new frames.
+// ``seq`` is the lockstep tick number (one AGG_REQUEST per subtree per
+// global tick); the root replays its last broadcast when a promoted
+// standby re-sends an already-answered seq.
+struct AggRequestList {
+  int32_t agg_id = -1;
+  int64_t seq = 0;
+  std::vector<int32_t> members;        // global ranks, ascending
+  std::vector<int32_t> hits_all;       // bits announced by every member
+  bool verify_folded = false;
+  std::vector<VerifyEntry> verify_all; // valid when verify_folded
+  std::vector<RequestList> residual;   // parallel to members
+};
+
+void Serialize(const AggRequestList& in, std::string* out);
+bool Deserialize(const char* data, size_t len, AggRequestList* out);
+
+// Aggregator-tier standby replication delta (the per-tier analog of the
+// PR-7 CoordState stream): the last tick the primary completed and the
+// exact ResponseList bytes it fanned out.  Sent to the standby AFTER the
+// root's response arrives and BEFORE the fan-out, so a promoted standby
+// can always replay the response to members the primary never reached —
+// response-stream continuity is load-bearing (cache replicas mutate by
+// applying every broadcast in order).
+struct AggState {
+  int64_t seq = -1;
+  std::string response;  // serialized ResponseList
+};
+
+void Serialize(const AggState& in, std::string* out);
+bool Deserialize(const char* data, size_t len, AggState* out);
 
 }  // namespace hvd

@@ -229,6 +229,18 @@ def reconfigure(eng=None) -> ResizeEvent:
     from horovod_tpu.analysis import schedule as _schedule
 
     _schedule.recorder().reset()
+    # A tree job re-forms as a STAR (docs/fault_tolerance.md): the shrunk
+    # membership invalidates the launcher-placed aggregator layout (group
+    # assignment is a function of the old size), and the relays are
+    # sidecars with no membership protocol of their own.  Every survivor
+    # computes this identically — the topology is a pure function of the
+    # knobs, and the knobs now say star.  Permanent for this process, so
+    # later reconfigurations stay star too.
+    if os.environ.get("HVD_TPU_TREE_ENABLE") \
+            or os.environ.get("HOROVOD_TREE_ENABLE"):
+        os.environ["HVD_TPU_TREE_ENABLE"] = "0"
+        os.environ.pop("HOROVOD_TREE_ENABLE", None)
+        os.environ.pop("HVD_TPU_TREE_AGG_MAP", None)
     # Bound the re-rendezvous by the reconfiguration budget, not the
     # generous first-boot connect budget: survivors are already running, so
     # a peer that cannot re-form in time means the membership changed again
@@ -402,6 +414,15 @@ def join(host: str, port: int, *, old_rank: int = -1,
     endpoint, so a joiner that raced a coordinator failover converges on
     the promoted standby instead of knocking forever on the dead rank 0's
     port."""
+    # Joining implies the membership reconfigured, and reconfiguration
+    # always re-forms the control plane as a star (see reconfigure()):
+    # drop any inherited tree knobs so the joiner's engine matches the
+    # survivors' topology.
+    if os.environ.get("HVD_TPU_TREE_ENABLE") \
+            or os.environ.get("HOROVOD_TREE_ENABLE"):
+        os.environ["HVD_TPU_TREE_ENABLE"] = "0"
+        os.environ.pop("HOROVOD_TREE_ENABLE", None)
+        os.environ.pop("HVD_TPU_TREE_AGG_MAP", None)
     budget = timeout_s
     if budget is None:
         budget = float(os.environ.get("HVD_TPU_CONNECT_TIMEOUT", "300") or 300)

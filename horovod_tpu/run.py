@@ -67,11 +67,24 @@ _TERM_GRACE_SECONDS = 5.0
 
 
 def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+    return _free_ports(1)[0]
+
+
+def _free_ports(n: int) -> list[int]:
+    """Reserve ``n`` distinct ephemeral ports in one batch.
+
+    Every reserving socket stays open until all ``n`` ports are picked:
+    closing them one at a time lets the kernel re-hand a freed port to a
+    later reservation in the same batch (observed as relay bind collisions
+    at fleet widths in the simulator)."""
+    socks = [socket.socket() for _ in range(n)]
+    try:
+        for s in socks:
+            s.bind(("127.0.0.1", 0))
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
 
 
 def _pump(stream, rank: int, tag: bool, lock: threading.Lock) -> None:
@@ -88,8 +101,11 @@ def _pump(stream, rank: int, tag: bool, lock: threading.Lock) -> None:
 def _child_env(rank: int, np_: int, jax_port: int, coord_port: int,
                platform: str | None, attempt: int,
                resume_dir: str | None, join: bool = False,
-               coord_file: str | None = None) -> dict[str, str]:
+               coord_file: str | None = None,
+               extra: dict[str, str] | None = None) -> dict[str, str]:
     env = dict(os.environ)
+    if extra:
+        env.update(extra)
     env["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{jax_port}"
     env["JAX_NUM_PROCESSES"] = str(np_)
     env["JAX_PROCESS_ID"] = str(rank)
@@ -177,7 +193,25 @@ def _run_once(command: list[str], args, attempt: int,
     process now holds rank 0 (docs/fault_tolerance.md "Coordinator
     failover")."""
     stats = stats if stats is not None else {}
-    jax_port, coord_port = _free_port(), _free_port()
+    # Hierarchical coordinator tree (docs/benchmarks.md "Control-plane
+    # scaling"): the launcher computes the SAME pure topology function the
+    # ranks will, and when it activates, spawns one aggregator-relay
+    # sidecar (plus a standby) per group and wires their endpoints into
+    # every rank's HVD_TPU_TREE_AGG_MAP.  All ports — jax, coordinator,
+    # and relay — come from one reservation batch.
+    from horovod_tpu import tree as tree_topo
+    from horovod_tpu.utils import env as hvd_env
+    plan = tree_topo.plan(args.np_, hvd_env.tree_fanout(),
+                          hvd_env.tree_threshold(), hvd_env.tree_enable())
+    want_standby = os.environ.get("HVD_TPU_TREE_STANDBY", "1") \
+        not in ("0", "false", "False")
+    per_group = 2 if want_standby else 1
+    ports = _free_ports(
+        2 + (plan.num_groups * per_group if plan.active else 0))
+    jax_port, coord_port = ports[0], ports[1]
+    relay_ports = ports[2:]
+    tree_env: dict[str, str] | None = None
+    relay_procs: list[subprocess.Popen] = []
     elastic = bool(getattr(args, "elastic", False))
     # The coordinator-endpoint file: seeded with rank 0's initial address,
     # rewritten by the promoted standby after a failover.  An inherited
@@ -199,12 +233,49 @@ def _run_once(command: list[str], args, attempt: int,
     procs: list[subprocess.Popen] = []
     pumps: list[threading.Thread] = []
     try:
+        if plan.active:
+            agg_eps = []
+            for g in range(plan.num_groups):
+                pport = relay_ports[g * per_group]
+                standby_ep = (("127.0.0.1", relay_ports[g * per_group + 1])
+                              if want_standby else None)
+                agg_eps.append((("127.0.0.1", pport), standby_ep))
+            # Pin every tree knob explicitly in the children's env so the
+            # ranks' native PlanTree answer can never drift from the plan
+            # the relays were placed for.
+            tree_env = {
+                "HVD_TPU_TREE_ENABLE": "1",
+                "HVD_TPU_TREE_FANOUT": str(plan.fanout),
+                "HVD_TPU_TREE_THRESHOLD": str(hvd_env.tree_threshold()),
+                "HVD_TPU_TREE_AGG_MAP": tree_topo.format_agg_map(agg_eps),
+            }
+            base = [sys.executable, "-m", "horovod_tpu.relay",
+                    "--parent-host", "127.0.0.1",
+                    "--parent-port", str(coord_port),
+                    "--size", str(args.np_),
+                    "--fanout", str(plan.fanout),
+                    "--threshold", str(hvd_env.tree_threshold())]
+            relay_env = dict(os.environ)
+            relay_env.update(tree_env)
+            for g, (primary, standby_ep) in enumerate(agg_eps):
+                relay_procs.append(subprocess.Popen(
+                    base + ["--agg-id", str(g),
+                            "--listen-port", str(primary[1])],
+                    env=relay_env, start_new_session=True))
+                if standby_ep is not None:
+                    relay_procs.append(subprocess.Popen(
+                        base + ["--agg-id", str(g),
+                                "--listen-port", str(standby_ep[1]),
+                                "--standby", "--peer-host", primary[0],
+                                "--peer-port", str(primary[1])],
+                        env=relay_env, start_new_session=True))
         for rank in range(args.np_):
             p = subprocess.Popen(
                 command,
                 env=_child_env(rank, args.np_, jax_port, coord_port,
                                args.platform or None, attempt, resume_dir,
-                               coord_file=coord_file if elastic else None),
+                               coord_file=coord_file if elastic else None,
+                               extra=tree_env),
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                 start_new_session=True)
             procs.append(p)
@@ -218,6 +289,7 @@ def _run_once(command: list[str], args, attempt: int,
         # A failed spawn (fork EAGAIN, bad command) must not leak the ranks
         # already started — they'd sit in the rendezvous for its full budget.
         _signal_job(procs, signal.SIGKILL)
+        _signal_job(relay_procs, signal.SIGKILL)
         raise
 
     # Expose the live procs to the launcher's signal handler.
@@ -322,7 +394,8 @@ def _run_once(command: list[str], args, attempt: int,
                                            args.platform or None,
                                            attempt + relaunch_counts[r],
                                            resume_dir, join=True,
-                                           coord_file=coord_file),
+                                           coord_file=coord_file,
+                                           extra=tree_env),
                             stdout=subprocess.PIPE,
                             stderr=subprocess.STDOUT,
                             start_new_session=True)
@@ -364,6 +437,15 @@ def _run_once(command: list[str], args, attempt: int,
     finally:
         _signal_job(procs, signal.SIGKILL)
         for p in procs:
+            try:
+                p.wait(timeout=2.0)
+            except subprocess.TimeoutExpired:
+                pass
+        # Relays exit on their own once the tree shuts down (clean) or
+        # their root uplink EOFs (abort); the kill is the backstop that
+        # keeps a wedged sidecar from outliving the attempt.
+        _signal_job(relay_procs, signal.SIGKILL)
+        for p in relay_procs:
             try:
                 p.wait(timeout=2.0)
             except subprocess.TimeoutExpired:

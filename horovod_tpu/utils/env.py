@@ -77,6 +77,34 @@ aliases; the TPU-specific defaults differ where the hardware does:
 * ``HVD_TPU_RECONFIG_TIMEOUT_MS`` — bound (default 30000) on in-place
   reconfiguration (resize acknowledgement + re-rendezvous); expiry falls
   back to abort-and-restart, keeping the nothing-blocks-forever guarantee.
+* ``HVD_TPU_TREE_ENABLE`` — hierarchical coordinator tree (default off):
+  workers split into per-aggregator groups whose relay sidecars fold each
+  group's tick into ONE frame for rank 0, dropping the root's per-tick
+  load from O(size) to O(groups) (core/src/tree.cc, docs/benchmarks.md
+  "Control-plane scaling").  Even when enabled, jobs below
+  ``HVD_TPU_TREE_THRESHOLD`` run the rank-0 star bit-for-bit unchanged.
+* ``HVD_TPU_TREE_FANOUT`` — worker ranks per aggregator group (default
+  64; the 4096-rank fleet-simulator sweep lands at 128 — root cost is
+  per-aggregator-frame, so bigger fleets want wider groups).
+* ``HVD_TPU_TREE_THRESHOLD`` — job size at which an enabled tree activates
+  (default 256, where the measured star tick starts crowding the 5 ms
+  cycle budget).
+* ``HVD_TPU_TREE_AGG_MAP`` — aggregator endpoints,
+  ``"0=host:port|host:port,1=host:port,..."`` (primary, optional standby
+  after ``|``; one entry per group).  Exported automatically by ``python
+  -m horovod_tpu.run`` when the tree activates; set by hand only for
+  multi-host relay placement (tree.py has the format/parse helpers).
+  An enabled tree with no map falls back to the star — the map's presence
+  is part of activation, so ranks can never disagree about topology.
+* ``HVD_TPU_TREE_EXCHANGE_TIMEOUT_MS`` / ``HVD_TPU_TREE_DETACH_TIMEOUT_MS``
+  / ``HVD_TPU_TREE_REATTACH_BUDGET_MS`` / ``HVD_TPU_TREE_PROMOTE_SILENCE_MS``
+  — tree failure-detection tuning (read in core/src/tree.cc): a member's
+  per-tick exchange bound (default 30000), how long the root carries a
+  silent aggregator before declaring its group lost (default 10000), a
+  member's budget for re-attaching to the promoted standby (default
+  30000), and the member-knock silence after which a standby concludes
+  its primary is wedged — not merely slow — and promotes (default 1000;
+  this, not EOF, bounds recovery from a SIGSTOP'd aggregator).
 * ``HOROVOD_OVERLAP_BUCKETS`` — chained-bucket OVERRIDE for the compiled
   single-axis allreduce path.  Unset (the default): the AdaptivePlanner
   (ops/schedule_plan.py) picks the chain depth at trace time from the
@@ -247,6 +275,37 @@ def abort_grace_ms() -> float:
 def hierarchical_allreduce() -> bool:
     raw = _get("HIERARCHICAL_ALLREDUCE")
     return bool(raw) and raw not in ("0", "false", "False")
+
+
+DEFAULT_TREE_FANOUT = 64
+DEFAULT_TREE_THRESHOLD = 256
+
+
+def tree_enable() -> bool:
+    """``HVD_TPU_TREE_ENABLE`` — opt into the hierarchical coordinator tree
+    (default off: the rank-0 star stays bit-for-bit the shipped behaviour).
+    Even when enabled, the tree activates only at ``tree_threshold()`` ranks
+    and above — below it the plan is inactive and the star runs."""
+    raw = _get("TREE_ENABLE")
+    return bool(raw) and raw not in ("0", "false", "False")
+
+
+def tree_fanout() -> int:
+    """``HVD_TPU_TREE_FANOUT`` — worker ranks per aggregator group (default
+    64).  Root per-tick cost is per-aggregator-frame, so larger fleets want
+    wider groups: the fleet-simulator sweep (docs/benchmarks.md) lands at
+    128 for 4096 ranks.  Values < 2 deactivate the tree."""
+    raw = _get("TREE_FANOUT")
+    return int(raw) if raw not in (None, "") else DEFAULT_TREE_FANOUT
+
+
+def tree_threshold() -> int:
+    """``HVD_TPU_TREE_THRESHOLD`` — job size at which an enabled tree
+    activates (default 256, the width where the star's measured tick starts
+    crowding the 5 ms cycle budget; docs/benchmarks.md).  Below it the
+    rank-0 star runs unchanged."""
+    raw = _get("TREE_THRESHOLD")
+    return int(raw) if raw not in (None, "") else DEFAULT_TREE_THRESHOLD
 
 
 def verify_schedule() -> bool:
