@@ -45,6 +45,7 @@ for _mod, _names in {
     ),
     "horovod_tpu.analysis.schedule": ("divergence_report",),
     "horovod_tpu.replication": ("replication_stats",),
+    "horovod_tpu.serving.engine": ("serving_stats",),
     "horovod_tpu.core.engine": ("CollectiveError", "MembershipChanged"),
     "horovod_tpu.elastic": ("coordinator_endpoint", "on_reconfigure",
                             "resize_event"),
@@ -83,8 +84,8 @@ _MODULE_ATTRS = {"profiling": "horovod_tpu.utils.profiling"}
 _SUBMODULES = frozenset({
     "basics", "callbacks", "checkpoint", "core", "data", "dataplane",
     "elastic", "faults", "flax", "keras", "mesh", "models", "ops",
-    "parallel", "relay", "replication", "run", "tensorflow", "torch",
-    "training", "tree", "utils",
+    "parallel", "relay", "replication", "run", "serving", "tensorflow",
+    "torch", "training", "tree", "utils",
 })
 
 # NOTE: __all__ deliberately excludes the lazy submodules — a star-import

@@ -688,6 +688,105 @@ class HandTunedContextLayout(Rule):
         return out
 
 
+class UnbucketedServeShape(Rule):
+    """Request-length-shaped inputs to a compiled function in a serve loop.
+
+    A serving loop calls its jitted prefill/decode once per request (or
+    per step); jax compiles one program per INPUT SHAPE.  An argument
+    whose shape is derived from ``len(prompt)`` — ``jnp.zeros((len(p),
+    ...))``, ``tokens[:len(p)]`` — therefore recompiles for every novel
+    request length: the compile cache grows without bound, tail latency
+    absorbs multi-second XLA compiles mid-traffic, and on a fleet the
+    ranks' response caches never warm because every shape is a fresh
+    negotiation.  The serving engine's contract (serving/engine.py) is a
+    fixed bucket menu: pad the prompt to the smallest bucket that holds
+    it and pass the true length as a SCALAR (scalars are 0-d operands,
+    not shapes — they never recompile).  Passing ``len(p)`` as a plain
+    argument is accordingly fine; only shape-position uses are flagged.
+
+    Callees considered serve-loop entry points: names bound from
+    ``jax.jit(...)`` in the same module, and ``prefill``/``decode``-named
+    calls (the backend protocol's verbs).  Deliberate one-shape fixtures
+    carry ``# hvd-lint: disable=HVD109``.
+    """
+
+    code = "HVD109"
+    name = "unbucketed-serve-shape"
+    hint = ("pad the prompt to a fixed bucket (ServingConfig.buckets; "
+            "smallest bucket >= len(prompt)) and pass the true length as "
+            "a scalar argument — one compile per bucket, not per request "
+            "length; mark deliberate one-shape fixtures with "
+            "`# hvd-lint: disable=HVD109`")
+
+    _SHAPE_CTORS = frozenset({"zeros", "ones", "full", "empty", "arange"})
+    _SERVE_VERBS = ("prefill", "decode")
+
+    @staticmethod
+    def _jit_bound_names(ctx: Context) -> frozenset[str]:
+        """Names assigned from ``jax.jit(...)`` / ``jit(...)`` — including
+        ``self.f = jax.jit(...)`` method-style bindings."""
+        out: set[str] = set()
+        for node in ast.walk(ctx.module):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            path = dotted(node.value.func)
+            if path is None or ctx.resolve(path).split(".")[-1] != "jit":
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+                elif isinstance(t, ast.Attribute):
+                    out.add(t.attr)
+        return frozenset(out)
+
+    @classmethod
+    def _len_shaped(cls, arg: ast.expr) -> ast.AST | None:
+        """A node inside ``arg`` whose SHAPE depends on ``len(...)``:
+        a shape-constructor with len() in its arguments, or a slice
+        bounded by len().  Scalar len() uses return None."""
+        for node in ast.walk(arg):
+            if isinstance(node, ast.Call) and \
+                    call_name(node) in cls._SHAPE_CTORS:
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call) and \
+                            call_name(sub) == "len":
+                        return node
+            elif isinstance(node, ast.Subscript):
+                for sub in ast.walk(node.slice):
+                    if isinstance(sub, ast.Call) and \
+                            call_name(sub) == "len":
+                        return node
+        return None
+
+    def run(self, ctx: Context) -> list[Finding]:
+        jit_names = self._jit_bound_names(ctx)
+        out: list[Finding] = []
+        for loop in ast.walk(ctx.module):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            for node in ast.walk(loop):
+                if not isinstance(node, ast.Call):
+                    continue
+                cname = call_name(node)
+                if cname is None:
+                    continue
+                is_serve = cname in jit_names or any(
+                    v in cname.lower() for v in self._SERVE_VERBS)
+                if not is_serve:
+                    continue
+                for arg in list(node.args) + [k.value for k in
+                                              node.keywords]:
+                    if self._len_shaped(arg) is not None:
+                        out.append(self.finding(node, (
+                            f"'{cname}' is called in a serve loop with an "
+                            f"argument shaped by len(...): one XLA "
+                            f"compile per novel request length, unbounded "
+                            f"compile cache, cold response cache")))
+                        break
+        return out
+
+
 RULES: list[Rule] = [
     RankDivergentCollective(),
     UnnamedCollectiveInLoop(),
@@ -697,4 +796,5 @@ RULES: list[Rule] = [
     StaleTopologyConstant(),
     HandTunedOverlapKnob(),
     HandTunedContextLayout(),
+    UnbucketedServeShape(),
 ]

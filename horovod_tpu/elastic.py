@@ -423,6 +423,11 @@ def join(host: str, port: int, *, old_rank: int = -1,
         os.environ["HVD_TPU_TREE_ENABLE"] = "0"
         os.environ.pop("HOROVOD_TREE_ENABLE", None)
         os.environ.pop("HVD_TPU_TREE_AGG_MAP", None)
+    # The native monitor's PollJoinRequest() hands the knocker's id to a
+    # caller that treats negatives as "no join pending" — a -1 payload
+    # would park this connection unserviced and wedge every later joiner.
+    # Joiners with no prior seat (autoscaled replicas) knock as rank 0.
+    old_rank = max(0, old_rank)
     budget = timeout_s
     if budget is None:
         budget = float(os.environ.get("HVD_TPU_CONNECT_TIMEOUT", "300") or 300)

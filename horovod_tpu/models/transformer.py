@@ -103,11 +103,44 @@ def dense_causal_attention(q, k, v, causal: bool = True):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def init_kv_cache(cfg: TransformerConfig, num_slots: int,
+                  max_len: int | None = None):
+    """Preallocated per-slot K/V cache for incremental decode
+    (docs/inference.md "Serving loop"): two ``[L, slots, S, H, D]`` arrays
+    in the compute dtype.  One slot is one serving sequence — the
+    continuous-batching scheduler (serving/engine.py) admits a request
+    into a free slot (prefill writes positions ``0..len``) and decode
+    appends one position per step, so the buffer is allocated once and
+    the jitted programs never see a shape change."""
+    s = max_len or cfg.max_seq_len
+    shape = (cfg.num_layers, num_slots, s, cfg.num_heads, cfg.head_dim)
+    return jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype)
+
+
+def cached_decode_attention(q, k_cache, v_cache, lengths):
+    """One-position attention over a per-slot KV cache.
+
+    ``q``: [B, 1, H, D] (the position being decoded per slot),
+    ``k_cache``/``v_cache``: [B, S, H, D] with positions ``0..lengths[b]``
+    valid (``lengths[b]`` is the position just written), everything past
+    it masked.  Same f32-softmax/-1e30-mask arithmetic as
+    :func:`dense_causal_attention`, so an incrementally decoded position
+    matches the full forward pass."""
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache).astype(
+        jnp.float32) * scale
+    s = k_cache.shape[1]
+    mask = (jnp.arange(s)[None, :] <= lengths[:, None])[:, None, None, :]
+    logits = jnp.where(mask, logits, -1e30)
+    probs = nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache)
+
+
 class Attention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, cache=None, return_kv=False):
         cfg = self.cfg
         proj = lambda name: nn.DenseGeneral(  # noqa: E731
             (cfg.num_heads, cfg.head_dim), use_bias=False, dtype=cfg.dtype,
@@ -115,6 +148,24 @@ class Attention(nn.Module):
         q = rope(proj("q")(x), positions, cfg.rope_theta)
         k = rope(proj("k")(x), positions, cfg.rope_theta)
         v = proj("v")(x)
+        o_proj = nn.DenseGeneral(cfg.embed_dim, axis=(-2, -1), use_bias=False,
+                                 dtype=cfg.dtype,
+                                 param_dtype=cfg.param_dtype, name="o")
+        if cache is not None:
+            # Incremental decode: x is [B, 1, E]; write this position's K/V
+            # into each slot's cache at its current length, attend over the
+            # cache.  K/V at a position depend only on that position's token
+            # and rotary phase, so cached entries match what a full forward
+            # pass would compute there.
+            import jax
+
+            k_cache, v_cache, lengths = cache
+            upd = lambda c, u, i: jax.lax.dynamic_update_slice(  # noqa: E731
+                c, u, (i, 0, 0))
+            k_cache = jax.vmap(upd)(k_cache, k, lengths)
+            v_cache = jax.vmap(upd)(v_cache, v, lengths)
+            out = cached_decode_attention(q, k_cache, v_cache, lengths)
+            return o_proj(out), (k_cache, v_cache)
         attn = cfg.attention_fn
         if attn is None and cfg.context_axis and cfg.context_plan is not None:
             from horovod_tpu.parallel.context import context_attention_fn
@@ -122,9 +173,9 @@ class Attention(nn.Module):
             attn = context_attention_fn(cfg.context_axis, cfg.context_plan)
         attn = attn or dense_causal_attention
         out = attn(q, k, v, causal=True)
-        return nn.DenseGeneral(cfg.embed_dim, axis=(-2, -1), use_bias=False,
-                               dtype=cfg.dtype,
-                               param_dtype=cfg.param_dtype, name="o")(out)
+        if return_kv:
+            return o_proj(out), (k, v)
+        return o_proj(out)
 
 
 class MLP(nn.Module):
@@ -146,22 +197,32 @@ class Block(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, cache=None, return_kv=False):
         cfg = self.cfg
         y = FusedRMSNorm(dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                          use_fused=cfg.fused_norm, name="attn_norm")(x)
-        x = x + Attention(cfg, name="attn")(y, positions)
+        kv = None
+        if cache is not None or return_kv:
+            attn_out, kv = Attention(cfg, name="attn")(
+                y, positions, cache=cache, return_kv=return_kv)
+        else:
+            attn_out = Attention(cfg, name="attn")(y, positions)
+        x = x + attn_out
         y = FusedRMSNorm(dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                          use_fused=cfg.fused_norm, name="mlp_norm")(x)
         if cfg.moe_axis is not None:
             from horovod_tpu.models.moe import MoEMLP
 
             # Residual carries over-capacity (dropped) tokens unchanged.
-            return x + MoEMLP(embed_dim=cfg.embed_dim, mlp_dim=cfg.mlp_dim,
-                              axis_name=cfg.moe_axis,
-                              capacity_factor=cfg.moe_capacity_factor,
-                              dtype=cfg.dtype, name="moe_mlp")(y)
-        return x + MLP(cfg, name="mlp")(y)
+            x = x + MoEMLP(embed_dim=cfg.embed_dim, mlp_dim=cfg.mlp_dim,
+                           axis_name=cfg.moe_axis,
+                           capacity_factor=cfg.moe_capacity_factor,
+                           dtype=cfg.dtype, name="moe_mlp")(y)
+        else:
+            x = x + MLP(cfg, name="mlp")(y)
+        if cache is not None or return_kv:
+            return x, kv
+        return x
 
 
 class Transformer(nn.Module):
@@ -175,15 +236,31 @@ class Transformer(nn.Module):
     ``cfg.context_axis`` + ``cfg.context_plan`` set, positions, the
     attention path, and the remat policy all derive from the plan (see
     ``parallel/context.py``); explicit arguments still win.
+
+    Serving (docs/inference.md "Serving loop"):
+
+    * ``return_kv=True`` — a prefill pass: also return the per-layer
+      rotary-embedded K and raw V as two stacked ``[L, B, S, H, D]``
+      arrays, for writing into a slot of an :func:`init_kv_cache` buffer.
+    * ``kv_cache=(k, v)`` + ``lengths`` — one incremental decode step:
+      ``tokens`` is ``[B, 1]`` (the last sampled token per slot),
+      ``lengths`` ``[B]`` the position each slot is decoding at; returns
+      ``(logits [B, vocab], (k, v))`` with the caches advanced in place.
+      The decode program's shapes are fixed by the slot count, so the
+      jitted step never recompiles as sequences come and go.
     """
 
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, position_offset=0, positions=None):
+    def __call__(self, tokens, position_offset=0, positions=None,
+                 kv_cache=None, lengths=None, return_kv=False):
         cfg = self.cfg
+        decode = kv_cache is not None
         x = nn.Embed(cfg.vocab_size, cfg.embed_dim, dtype=cfg.dtype,
                      param_dtype=cfg.param_dtype, name="embed")(tokens)
+        if decode:
+            positions = jnp.asarray(lengths)[:, None]
         if positions is None and cfg.context_axis and \
                 cfg.context_plan is not None:
             from horovod_tpu.parallel.context import context_positions
@@ -197,10 +274,22 @@ class Transformer(nn.Module):
             positions = positions[None, :]
         positions = jnp.broadcast_to(positions, tokens.shape)
         remat_on = (cfg.remat if cfg.context_plan is None
-                    else cfg.context_plan.remat)
+                    else cfg.context_plan.remat) and not decode \
+            and not return_kv
         block_cls = nn.remat(Block) if remat_on else Block
+        kvs = []
         for i in range(cfg.num_layers):
-            x = block_cls(cfg, name=f"layer_{i}")(x, positions)
+            if decode:
+                x, kv = block_cls(cfg, name=f"layer_{i}")(
+                    x, positions,
+                    cache=(kv_cache[0][i], kv_cache[1][i], lengths))
+                kvs.append(kv)
+            elif return_kv:
+                x, kv = block_cls(cfg, name=f"layer_{i}")(
+                    x, positions, return_kv=True)
+                kvs.append(kv)
+            else:
+                x = block_cls(cfg, name=f"layer_{i}")(x, positions)
         x = FusedRMSNorm(dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                          use_fused=cfg.fused_norm, name="final_norm")(x)
         # Head matmul in the compute dtype (bf16 hits the MXU at full rate;
@@ -209,4 +298,11 @@ class Transformer(nn.Module):
         # replaces was ~15% of step time (docs/benchmarks.md profile).
         logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                           param_dtype=cfg.param_dtype, name="lm_head")(x)
-        return logits.astype(cfg.logits_dtype)
+        logits = logits.astype(cfg.logits_dtype)
+        if decode:
+            return logits[:, 0], (jnp.stack([kv[0] for kv in kvs]),
+                                  jnp.stack([kv[1] for kv in kvs]))
+        if return_kv:
+            return logits, (jnp.stack([kv[0] for kv in kvs]),
+                            jnp.stack([kv[1] for kv in kvs]))
+        return logits

@@ -319,6 +319,17 @@ def _ship_shard(eng, dst: int, step: int, shard_index: int, cut_size: int,
     return None
 
 
+def ship_blob(eng, dst: int, step: int, blob: bytes) -> str | None:
+    """Ship one COMPLETE snapshot blob to one peer as a single shard
+    (shard 0, cut == total), riding the same direct->relay fallback chain
+    as replica shards.  The receiver's store then has a trivially complete
+    set, so ``restore_local(epoch)`` decodes it with zero transfers and
+    zero disk reads — this is the serving autoscaler's weight-clone and
+    hot-swap path (serving/autoscale.py): ``step`` carries the weight
+    version, and election's newest-step rule makes later versions win."""
+    return _ship_shard(eng, dst, int(step), 0, len(blob), len(blob), blob)
+
+
 def put(step: int, state: Any, metadata: dict | None = None,
         eng: "core_engine.NativeEngine | None" = None) -> bool:
     """Shard a snapshot across the membership: keep shard ``rank``

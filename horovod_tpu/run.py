@@ -508,6 +508,13 @@ def main(argv: list[str] | None = None) -> int:
                              "death promotes the in-job standby and the "
                              "dead seat rejoins via HVD_TPU_COORD_FILE "
                              "(docs/fault_tolerance.md)")
+    parser.add_argument("--serve", action="store_true",
+                        help="serving mode: the default command becomes "
+                             "'python -m horovod_tpu.serving' (one "
+                             "continuous-batching replica per rank, "
+                             "docs/inference.md 'Serving loop') and "
+                             "--elastic is implied so dead replicas rejoin "
+                             "and clone weights over the data plane")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="program and arguments (e.g. python train.py)")
     args = parser.parse_args(argv)
@@ -523,6 +530,10 @@ def main(argv: list[str] | None = None) -> int:
     command = args.command
     if command and command[0] == "--":
         command = command[1:]
+    if args.serve:
+        args.elastic = True
+        if not command:
+            command = [sys.executable, "-m", "horovod_tpu.serving"]
     if not command:
         parser.error("no command given (e.g. ... -np 2 python train.py)")
     if os.environ.get("HVD_TPU_ELASTIC", "") not in ("", "0", "false",

@@ -1,0 +1,33 @@
+"""horovod_tpu.serving — continuous-batching inference serving.
+
+The request-driven half of the north star (ROADMAP item 2): a decode
+engine in the continuous-batching style of Orca/vLLM-class systems —
+admit and evict sequences mid-batch inside fixed bucket shapes, so the
+jitted prefill/decode programs never recompile and the PR-3 response
+cache stays warm — plus an elastic autoscaler that grows and shrinks the
+replica fleet with the existing JOIN/RECONFIG machinery, cloning weights
+to joiners over the PR-11 bulk data plane (zero disk reads).
+
+Layout:
+
+* ``engine.py``    — ``ServingEngine`` scheduler, backends, and
+  ``hvd.serving_stats()``.
+* ``autoscale.py`` — queue-depth/p99-driven replica-count policy and the
+  data-plane weight clone / hot-swap helpers.
+* ``loadgen.py``   — open-loop Poisson load generator and latency report.
+* ``worker.py``    — one serving replica speaking a line protocol
+  (used by the soak fleet and ``run.py --serve``).
+* ``soak.py``      — multi-process autoscale/replica-kill soak driver.
+
+Module-level imports stay jax-free so engine-only fleets (soak workers,
+bench subprocesses) boot without paying the jax import.
+"""
+
+from __future__ import annotations
+
+from horovod_tpu.serving.engine import (Request, ServingConfig,
+                                        ServingEngine, StubBackend,
+                                        TransformerBackend, serving_stats)
+
+__all__ = ["Request", "ServingConfig", "ServingEngine", "StubBackend",
+           "TransformerBackend", "serving_stats"]

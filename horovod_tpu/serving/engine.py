@@ -1,0 +1,424 @@
+"""Continuous-batching decode engine (docs/inference.md "Serving loop").
+
+The scheduler packs active sequences into a fixed number of KV-cache
+*slots* and runs one jitted decode step over all slots per tick.  New
+requests are admitted into freed slots every step (prefill is bucketed to
+a fixed shape menu, so the compile cache is a small finite set) and
+finished or over-length sequences are evicted mid-batch — no drain
+barriers.  Because every program shape is fixed by the slot count and the
+bucket menu, the jitted programs never recompile and the eager control
+plane's response cache stays warm (steady-state decode ticks are all
+CACHE_HIT — asserted in tests/test_serving.py from ``cache_stats()``).
+
+The engine is backend-agnostic: ``TransformerBackend`` runs the real
+model on the KV-cache path of models/transformer.py; ``StubBackend`` is
+a numpy token automaton for engine-only fleets (soak workers, bench
+subprocesses) that must not pay the jax import.  Every backend op is
+batch-row-independent, which is what makes continuous batching *safe*:
+a sequence's logits in a mixed batch are bit-identical to the same
+sequence decoded alone through the same-shaped program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+from collections import deque
+from typing import Any, Callable
+
+import numpy as np
+
+_ACTIVE = None  # most recently constructed ServingEngine, for serving_stats()
+
+_STATS_KEYS = (
+    "active_slots", "queue_depth", "admitted", "evicted", "completed",
+    "rejected", "retried", "steps", "tokens", "ttft_p50_ms", "ttft_p99_ms",
+    "token_p50_ms", "token_p99_ms", "kv_slot_occupancy",
+)
+
+
+def _pctile(xs, q: float) -> float:
+    """Nearest-rank percentile; 0.0 on empty — jax-free, matches the
+    loadgen's reporting so engine and client percentiles are comparable."""
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    return float(xs[min(len(xs) - 1, int(q / 100.0 * len(xs)))])
+
+
+@dataclasses.dataclass
+class Request:
+    """One serving request as it moves QUEUED → ACTIVE → DONE.
+
+    ``tokens`` accumulates the generated ids; ``finish_reason`` is one of
+    ``"eos"``, ``"max_new_tokens"``, ``"max_seq_len"`` (evicted over
+    length), or ``"rejected"`` (prompt fits no bucket).  Timing fields are
+    engine-clock seconds; ``logits`` is populated only under
+    ``ServingConfig.record_logits`` (the bit-exactness test)."""
+
+    rid: int
+    prompt: list[int]
+    max_new_tokens: int
+    submitted_t: float = 0.0
+    state: str = "QUEUED"
+    slot: int = -1
+    tokens: list[int] = dataclasses.field(default_factory=list)
+    logits: list[Any] = dataclasses.field(default_factory=list)
+    finish_reason: str | None = None
+    ttft_s: float | None = None
+    token_lat_s: list[float] = dataclasses.field(default_factory=list)
+    _last_token_t: float = 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    """Scheduler knobs; defaults come from the HVD_TPU_SERVE_* env table
+    (utils/env.py) when constructed via :func:`from_env`."""
+
+    num_slots: int = 8
+    # Prefill length menu, ascending.  A prompt compiles against the
+    # smallest bucket that holds it, so the prefill compile cache has at
+    # most len(buckets) entries regardless of traffic mix.
+    buckets: tuple[int, ...] = (16, 32, 64, 128)
+    max_seq_len: int = 256
+    eos_id: int | None = None
+    # Baseline mode for the bench: admit only into a fully drained batch
+    # (the classic static-batching barrier) instead of per-step.
+    static_batching: bool = False
+    # Keep per-step logits on each request (tests only — unbounded).
+    record_logits: bool = False
+
+    @staticmethod
+    def from_env(**overrides) -> "ServingConfig":
+        from horovod_tpu.utils import env
+
+        base = dict(num_slots=env.serve_slots(), buckets=env.serve_buckets(),
+                    max_seq_len=env.serve_max_len())
+        base.update(overrides)
+        return ServingConfig(**base)
+
+
+class StubBackend:
+    """Deterministic token automaton — no jax, no model.
+
+    The next token is a pure function of (previous token, position), so a
+    request replayed on any replica after a retry produces the identical
+    completion; the soak driver (serving/soak.py) relies on this to check
+    no accepted request is lost or corrupted.  ``step_s`` adds synthetic
+    per-step compute so requests stay in flight long enough to be killed
+    mid-decode."""
+
+    def __init__(self, num_slots: int, vocab_size: int = 256,
+                 step_s: float = 0.0):
+        self.num_slots = num_slots
+        self.vocab_size = vocab_size
+        self.step_s = step_s
+
+    @staticmethod
+    def _next(prev: int, pos: int, vocab: int) -> int:
+        return (prev * 31 + pos * 7 + 1) % vocab
+
+    def prefill(self, padded: np.ndarray, length: int, slot: int):
+        first = (int(np.sum(padded[0, :length])) + length) % self.vocab_size
+        logits = np.zeros(self.vocab_size, np.float32)
+        logits[first] = 1.0
+        return first, logits
+
+    def decode(self, last_tokens: np.ndarray, lengths: np.ndarray):
+        if self.step_s:
+            time.sleep(self.step_s)
+        nxt = np.array([self._next(int(t), int(p), self.vocab_size)
+                        for t, p in zip(last_tokens, lengths)], np.int32)
+        logits = np.zeros((self.num_slots, self.vocab_size), np.float32)
+        logits[np.arange(self.num_slots), nxt] = 1.0
+        return nxt, logits
+
+
+class TransformerBackend:
+    """Real-model backend on the KV-cache path of models/transformer.py.
+
+    One jitted prefill per bucket shape (full forward with
+    ``return_kv=True``, cache written into the admitted slot with
+    ``dynamic_update_slice``) and ONE jitted decode whose shapes are fixed
+    by the slot count — it runs every tick whatever the active set is, so
+    it compiles exactly once and its collective signature never changes.
+    Inactive slots decode garbage at position 0; the engine masks their
+    output and the next prefill overwrites their cache.  Sampling is
+    greedy (argmax) — deterministic, which the bit-exactness test needs.
+    """
+
+    def __init__(self, model, params, model_cfg, num_slots: int,
+                 max_seq_len: int):
+        import jax
+
+        self._jax = jax
+        self.model, self.params = model, params
+        self.num_slots, self.max_seq_len = num_slots, max_seq_len
+        from horovod_tpu.models.transformer import init_kv_cache
+
+        self.kk, self.vv = init_kv_cache(model_cfg, num_slots, max_seq_len)
+        self._prefill = jax.jit(self._prefill_fn, donate_argnums=(1, 2))
+        self._decode = jax.jit(self._decode_fn, donate_argnums=(1, 2))
+
+    def _prefill_fn(self, params, kk, vv, padded, length, slot):
+        jax, jnp = self._jax, self._jax.numpy
+        logits, (pk, pv) = self.model.apply(params, padded, return_kv=True)
+        kk = jax.lax.dynamic_update_slice(kk, pk, (0, slot, 0, 0, 0))
+        vv = jax.lax.dynamic_update_slice(vv, pv, (0, slot, 0, 0, 0))
+        last = jax.lax.dynamic_slice(
+            logits, (0, length - 1, 0), (1, 1, logits.shape[-1]))[0, 0]
+        return kk, vv, jnp.argmax(last).astype(jnp.int32), last
+
+    def _decode_fn(self, params, kk, vv, last_tokens, lengths):
+        jnp = self._jax.numpy
+        # The engine's lengths count the pending (not-yet-cached) token;
+        # the model wants the incoming token's position = cache fill count
+        # = lengths - 1.  Passing lengths unshifted would write K/V one
+        # slot too far, leaving a hole the mask still covers — zeros on a
+        # fresh slot, a previous occupant's stale K/V on a reused one.
+        logits, (kk, vv) = self.model.apply(
+            params, last_tokens[:, None], kv_cache=(kk, vv),
+            lengths=jnp.maximum(lengths - 1, 0))
+        return kk, vv, jnp.argmax(logits, axis=-1).astype(jnp.int32), logits
+
+    def prefill(self, padded: np.ndarray, length: int, slot: int):
+        jnp = self._jax.numpy
+        self.kk, self.vv, first, logits = self._prefill(
+            self.params, self.kk, self.vv, jnp.asarray(padded),
+            length, slot)
+        return int(first), np.asarray(logits)
+
+    def decode(self, last_tokens: np.ndarray, lengths: np.ndarray):
+        jnp = self._jax.numpy
+        self.kk, self.vv, nxt, logits = self._decode(
+            self.params, self.kk, self.vv, jnp.asarray(last_tokens),
+            jnp.asarray(lengths))
+        return np.asarray(nxt), np.asarray(logits)
+
+    def swap_params(self, params) -> None:
+        """Zero-downtime weight hot-swap: the next step (prefill or
+        decode) runs the new weights; program shapes are unchanged so
+        nothing recompiles.  In-flight sequences keep their KV cache —
+        same contract as every serving system doing online updates."""
+        self.params = params
+
+
+class ServingEngine:
+    """The continuous-batching scheduler.
+
+    Each :meth:`step` (i) admits queued requests into free slots —
+    prefill produces the first token, so TTFT is measured here — then
+    (ii) runs one fixed-shape decode over all slots and (iii) evicts
+    finished/over-length sequences, freeing their slots for the next
+    tick's admissions.  With ``collective=`` (a core.engine.NativeEngine)
+    every tick issues one fixed-name fixed-shape ``serving.tick``
+    allreduce, which both keeps the response cache warm and gives every
+    replica the fleet-aggregate counters the autoscaler reads; admissions
+    and evictions land as SERVING_ADMIT / SERVING_EVICT instants on its
+    timeline."""
+
+    TICK_NAME = "serving.tick"
+
+    def __init__(self, backend, config: ServingConfig | None = None,
+                 collective=None, clock: Callable[[], float] = time.monotonic,
+                 on_complete: Callable[[Request], None] | None = None):
+        global _ACTIVE
+        self.backend = backend
+        self.config = config or ServingConfig()
+        self.collective = collective
+        self.clock = clock
+        self.on_complete = on_complete
+        self.queue: deque[Request] = deque()
+        self.slots: list[Request | None] = [None] * self.config.num_slots
+        self.last_tokens = np.zeros(self.config.num_slots, np.int32)
+        self.lengths = np.zeros(self.config.num_slots, np.int32)
+        self.counters = dict.fromkeys(
+            ("admitted", "evicted", "completed", "rejected", "retried",
+             "steps", "tokens"), 0)
+        self._ttft_s: list[float] = []
+        self._token_s: list[float] = []
+        self._rid = itertools.count()
+        self.fleet: dict[str, float] = {}
+        # Set by drivers that know their request stream is exhausted; rides
+        # the tick vector so every replica can see fleet-wide completion
+        # (a replica must keep ticking until ALL replicas drain — stopping
+        # early would stall the others' collective).
+        self.done_flag = 0.0
+        _ACTIVE = self
+
+    # -- request intake ---------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int, rid: int | None = None,
+               retry: bool = False) -> Request:
+        req = Request(rid=next(self._rid) if rid is None else rid,
+                      prompt=list(prompt), max_new_tokens=max_new_tokens,
+                      submitted_t=self.clock())
+        if retry:
+            self.counters["retried"] += 1
+        if len(req.prompt) > max(self.config.buckets) or \
+                len(req.prompt) >= self.config.max_seq_len:
+            req.state, req.finish_reason = "DONE", "rejected"
+            self.counters["rejected"] += 1
+            return req
+        self.queue.append(req)
+        return req
+
+    def _bucket(self, n: int) -> int:
+        for b in self.config.buckets:
+            if b >= n:
+                return b
+        raise AssertionError("unbucketable prompt slipped past submit()")
+
+    # -- the tick ---------------------------------------------------------
+
+    def step(self) -> list[Request]:
+        done: list[Request] = []
+        self._admit(done)
+        if any(r is not None for r in self.slots):
+            nxt, logits = self.backend.decode(self.last_tokens, self.lengths)
+            now = self.clock()
+            for s, req in enumerate(self.slots):
+                if req is None:
+                    continue
+                self._take_token(req, s, int(nxt[s]), logits[s], now)
+                if req.state == "DONE":
+                    self._evict(req, s, done)
+        self.counters["steps"] += 1
+        self._tick_collective()
+        if self.on_complete:
+            for req in done:
+                self.on_complete(req)
+        return done
+
+    def _admit(self, done: list[Request]) -> None:
+        cfg = self.config
+        if cfg.static_batching and any(r is not None for r in self.slots):
+            return  # the drain barrier continuous batching removes
+        for s in range(cfg.num_slots):
+            if self.slots[s] is not None or not self.queue:
+                continue
+            req = self.queue.popleft()
+            bucket = self._bucket(len(req.prompt))
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :len(req.prompt)] = req.prompt
+            first, logits = self.backend.prefill(padded, len(req.prompt), s)
+            now = self.clock()
+            req.state, req.slot = "ACTIVE", s
+            req.ttft_s = now - req.submitted_t
+            self._ttft_s.append(req.ttft_s)
+            self.slots[s] = req
+            self.lengths[s] = len(req.prompt)
+            self.counters["admitted"] += 1
+            if self.collective is not None:
+                self.collective.timeline_instant(
+                    "SERVING_ADMIT", f"req={req.rid} slot={s} "
+                    f"len={len(req.prompt)} bucket={bucket}")
+            self._take_token(req, s, first, logits, now)
+            if req.state == "DONE":  # max_new_tokens == 1
+                self._evict(req, s, done)
+
+    def _take_token(self, req: Request, slot: int, token: int, logits,
+                    now: float) -> None:
+        req.tokens.append(token)
+        if self.config.record_logits:
+            req.logits.append(np.array(logits))
+        if req._last_token_t:
+            req.token_lat_s.append(now - req._last_token_t)
+            self._token_s.append(req.token_lat_s[-1])
+        req._last_token_t = now
+        self.last_tokens[slot] = token
+        self.lengths[slot] += 1
+        self.counters["tokens"] += 1
+        total = len(req.prompt) + len(req.tokens)
+        if self.config.eos_id is not None and token == self.config.eos_id:
+            req.state, req.finish_reason = "DONE", "eos"
+        elif len(req.tokens) >= req.max_new_tokens:
+            req.state, req.finish_reason = "DONE", "max_new_tokens"
+        elif total >= self.config.max_seq_len:
+            req.state, req.finish_reason = "DONE", "max_seq_len"
+
+    def _evict(self, req: Request, slot: int, done: list[Request]) -> None:
+        self.slots[slot] = None
+        self.last_tokens[slot] = 0
+        self.lengths[slot] = 0
+        self.counters["evicted"] += 1
+        self.counters["completed"] += 1
+        if self.collective is not None:
+            self.collective.timeline_instant(
+                "SERVING_EVICT", f"req={req.rid} slot={slot} "
+                f"reason={req.finish_reason} new={len(req.tokens)}")
+        done.append(req)
+
+    def _tick_collective(self) -> None:
+        if self.collective is None:
+            return
+        from horovod_tpu.core.engine import OP_ALLREDUCE
+
+        c = self.counters
+        vec = np.array([self._active_count(), len(self.queue), c["admitted"],
+                        c["evicted"], c["completed"], c["tokens"], c["steps"],
+                        self._occupancy(), self.done_flag], np.float32)
+        # Fixed name + shape + dtype every tick: after the first step the
+        # signature is a response-cache hit, never renegotiated.
+        h = self.collective.enqueue(self.TICK_NAME, vec, OP_ALLREDUCE)
+        agg = self.collective.synchronize(h)
+        self.fleet = dict(zip(("active", "queued", "admitted", "evicted",
+                               "completed", "tokens", "steps", "occupancy",
+                               "done_replicas"),
+                              (float(x) for x in agg)))
+
+    # -- draining & introspection -----------------------------------------
+
+    def run_until_idle(self, max_steps: int = 100000) -> list[Request]:
+        out: list[Request] = []
+        for _ in range(max_steps):
+            if not self.queue and self._active_count() == 0:
+                return out
+            out.extend(self.step())
+        raise RuntimeError("serving engine did not drain "
+                           f"within {max_steps} steps")
+
+    def _active_count(self) -> int:
+        return sum(r is not None for r in self.slots)
+
+    def _occupancy(self) -> float:
+        return float(np.sum(self.lengths)) / (
+            self.config.num_slots * self.config.max_seq_len)
+
+    def stats(self) -> dict:
+        c = self.counters
+        return {
+            "active_slots": self._active_count(),
+            "queue_depth": len(self.queue),
+            "admitted": c["admitted"], "evicted": c["evicted"],
+            "completed": c["completed"], "rejected": c["rejected"],
+            "retried": c["retried"], "steps": c["steps"],
+            "tokens": c["tokens"],
+            "ttft_p50_ms": _pctile(self._ttft_s, 50) * 1e3,
+            "ttft_p99_ms": _pctile(self._ttft_s, 99) * 1e3,
+            "token_p50_ms": _pctile(self._token_s, 50) * 1e3,
+            "token_p99_ms": _pctile(self._token_s, 99) * 1e3,
+            "kv_slot_occupancy": self._occupancy(),
+        }
+
+
+def serving_stats() -> dict:
+    """Scheduler counters for this process's serving engine
+    (docs/inference.md "Serving loop")::
+
+        {"active_slots": 5, "queue_depth": 2, "admitted": 40,
+         "evicted": 35, "completed": 35, "rejected": 0, "retried": 0,
+         "steps": 210, "tokens": 1180, "ttft_p50_ms": 3.1,
+         "ttft_p99_ms": 11.8, "token_p50_ms": 0.9, "token_p99_ms": 1.4,
+         "kv_slot_occupancy": 0.31}
+
+    ``admitted``/``evicted`` count slot transitions (every eviction also
+    lands as a SERVING_EVICT timeline instant); ``kv_slot_occupancy`` is
+    the filled fraction of the preallocated KV cache.  All zeros when no
+    ``ServingEngine`` has been constructed in this process — mirrors the
+    ``control_plane_stats()`` contract."""
+    if _ACTIVE is None:
+        return {k: 0 if isinstance(v, int) else 0.0 for k, v in
+                zip(_STATS_KEYS, (0,) * 9 + (0.0,) * 5)}
+    return _ACTIVE.stats()

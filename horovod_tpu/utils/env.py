@@ -173,6 +173,34 @@ aliases; the TPU-specific defaults differ where the hardware does:
 * ``HVD_TPU_CTX_REMAT`` — force the long-context remat policy (``1`` =
   full-layer remat, ``0`` = none) instead of the planner's
   activation-bytes-vs-headroom decision.  Unset: planner-decided.
+* ``HVD_TPU_SERVE_SLOTS`` — KV-cache slots per serving replica (default
+  8): the continuous-batching scheduler's fixed decode batch width
+  (docs/inference.md "Serving loop").
+* ``HVD_TPU_SERVE_BUCKETS`` — prefill length menu as ascending CSV
+  (default ``16,32,64,128``): a prompt compiles against the smallest
+  bucket that holds it, bounding the prefill compile cache at
+  len(buckets) programs.  Malformed entries degrade to the default with
+  a warning.
+* ``HVD_TPU_SERVE_MAX_LEN`` — per-slot KV-cache length (default 256);
+  sequences reaching it are evicted with ``finish_reason="max_seq_len"``.
+* ``HVD_TPU_SERVE_QUEUE_HIGH`` — autoscaler GROW threshold: queued
+  requests per replica (default 16).
+* ``HVD_TPU_SERVE_P99_MS`` — autoscaler GROW threshold on p99
+  time-to-first-token in ms (default 500; 0 disables the latency
+  trigger).
+* ``HVD_TPU_SERVE_IDLE_S`` — autoscaler SHRINK trigger: seconds of empty
+  queue + idle slots before releasing a replica (default 5).
+* ``HVD_TPU_SERVE_MIN_REPLICAS`` / ``HVD_TPU_SERVE_MAX_REPLICAS`` —
+  replica-count clamp for the autoscaler (defaults 1 / 8).
+* ``HVD_TPU_SERVE_COOLDOWN_S`` — minimum seconds between autoscale
+  decisions (default 2; a join costs a RECONFIG round, so the policy
+  must not flap).
+* ``HVD_TPU_SERVE_QPS`` / ``HVD_TPU_SERVE_DURATION_S`` — the
+  self-generated Poisson workload a ``run.py --serve`` replica drives
+  (defaults 20 QPS for 3 s).
+* ``HVD_TPU_SERVE_BACKEND`` — ``transformer`` (default: small real model
+  on the KV-cache decode path) or ``stub`` (jax-free token automaton)
+  for ``python -m horovod_tpu.serving`` replicas.
 """
 
 from __future__ import annotations
@@ -608,3 +636,102 @@ def ctx_remat_override() -> bool | None:
     if raw in (None, ""):
         return None
     return raw not in ("0", "false", "False")
+
+
+def _serve_number(name: str, default, cast, floor=None):
+    """Shared numeric parse for the HVD_TPU_SERVE_* family: unset or
+    malformed degrades to the default (with a warning for malformed) —
+    a bad knob must never take a serving replica down."""
+    raw = _get(name)
+    if raw in (None, ""):
+        return default
+    try:
+        value = cast(raw)
+        if floor is not None and value < floor:
+            raise ValueError("below floor")
+    except ValueError:
+        import warnings
+
+        warnings.warn(
+            f"HVD_TPU_{name}={raw!r} is not a valid value; using the "
+            f"default {default}", RuntimeWarning, stacklevel=3)
+        return default
+    return value
+
+
+def serve_slots() -> int:
+    """``HVD_TPU_SERVE_SLOTS`` — KV-cache slots per serving replica
+    (default 8): the fixed decode batch width."""
+    return _serve_number("SERVE_SLOTS", 8, int, floor=1)
+
+
+def serve_buckets() -> tuple[int, ...]:
+    """``HVD_TPU_SERVE_BUCKETS`` — ascending prefill length menu (CSV;
+    default ``16,32,64,128``).  Malformed: default + warning."""
+    raw = _get("SERVE_BUCKETS")
+    if raw in (None, ""):
+        return (16, 32, 64, 128)
+    try:
+        buckets = tuple(sorted(int(b) for b in raw.split(",") if b.strip()))
+        if not buckets or any(b <= 0 for b in buckets):
+            raise ValueError("empty or non-positive bucket")
+    except ValueError:
+        import warnings
+
+        warnings.warn(
+            f"HVD_TPU_SERVE_BUCKETS={raw!r} is not an ascending int CSV; "
+            "using the default (16,32,64,128)", RuntimeWarning, stacklevel=3)
+        return (16, 32, 64, 128)
+    return buckets
+
+
+def serve_max_len() -> int:
+    """``HVD_TPU_SERVE_MAX_LEN`` — per-slot KV-cache length (default
+    256); the over-length eviction bound."""
+    return _serve_number("SERVE_MAX_LEN", 256, int, floor=2)
+
+
+def serve_queue_high() -> float:
+    """``HVD_TPU_SERVE_QUEUE_HIGH`` — autoscaler GROW threshold in queued
+    requests per replica (default 16)."""
+    return _serve_number("SERVE_QUEUE_HIGH", 16.0, float, floor=0.0)
+
+
+def serve_p99_ms() -> float:
+    """``HVD_TPU_SERVE_P99_MS`` — autoscaler GROW threshold on p99 TTFT
+    in ms (default 500; 0 disables the latency trigger)."""
+    return _serve_number("SERVE_P99_MS", 500.0, float, floor=0.0)
+
+
+def serve_idle_s() -> float:
+    """``HVD_TPU_SERVE_IDLE_S`` — idle seconds before the autoscaler
+    SHRINKs (default 5)."""
+    return _serve_number("SERVE_IDLE_S", 5.0, float, floor=0.0)
+
+
+def serve_min_replicas() -> int:
+    """``HVD_TPU_SERVE_MIN_REPLICAS`` — autoscaler floor (default 1)."""
+    return _serve_number("SERVE_MIN_REPLICAS", 1, int, floor=1)
+
+
+def serve_max_replicas() -> int:
+    """``HVD_TPU_SERVE_MAX_REPLICAS`` — autoscaler ceiling (default 8)."""
+    return _serve_number("SERVE_MAX_REPLICAS", 8, int, floor=1)
+
+
+def serve_cooldown_s() -> float:
+    """``HVD_TPU_SERVE_COOLDOWN_S`` — minimum seconds between autoscale
+    decisions (default 2)."""
+    return _serve_number("SERVE_COOLDOWN_S", 2.0, float, floor=0.0)
+
+
+def serve_qps() -> float:
+    """``HVD_TPU_SERVE_QPS`` — Poisson arrival rate a ``--serve`` replica
+    drives at itself (default 20)."""
+    return _serve_number("SERVE_QPS", 20.0, float, floor=0.001)
+
+
+def serve_duration_s() -> float:
+    """``HVD_TPU_SERVE_DURATION_S`` — workload duration for a ``--serve``
+    replica (default 3)."""
+    return _serve_number("SERVE_DURATION_S", 3.0, float, floor=0.01)

@@ -418,6 +418,68 @@ def test_hvd108_suppressible_for_audit_fixtures():
 
 
 # ---------------------------------------------------------------------------
+# HVD109 — unbucketed serve shapes (one compile per request length)
+# ---------------------------------------------------------------------------
+
+def test_hvd109_len_shaped_jit_input_in_serve_loop():
+    # The canonical recompile-per-length bug: a jit-bound callee fed a
+    # len(prompt)-shaped array inside the serve loop.
+    assert codes("""
+        import jax
+        import jax.numpy as jnp
+
+        decode_fn = jax.jit(lambda t: t * 2)
+
+        def serve(requests):
+            while requests:
+                prompt = requests.pop()
+                decode_fn(jnp.zeros((len(prompt),), jnp.int32))
+    """) == ["HVD109"]
+
+
+def test_hvd109_len_sliced_prefill_input():
+    # Slices bounded by len() shape the operand too — and the backend
+    # verbs (prefill/decode) count as serve entry points even when the
+    # jit binding is in another module.
+    assert codes("""
+        import numpy as np
+
+        def serve(backend, requests, tokens):
+            for prompt in requests:
+                backend.prefill(tokens[:len(prompt)], len(prompt), 0)
+    """) == ["HVD109"]
+
+
+def test_hvd109_clean_bucketed_twin():
+    # The sanctioned shape discipline: pad to a fixed bucket, pass the
+    # true length as a scalar (0-d operands never recompile).
+    assert codes("""
+        import numpy as np
+
+        def serve(backend, requests, buckets):
+            for prompt in requests:
+                bucket = min(b for b in buckets if b >= len(prompt))
+                padded = np.zeros((1, bucket), np.int32)
+                padded[0, :len(prompt)] = prompt
+                backend.prefill(padded, len(prompt), 0)
+    """) == []
+
+
+def test_hvd109_suppressible_for_one_shape_fixtures():
+    assert codes("""
+        import jax
+        import jax.numpy as jnp
+
+        decode_fn = jax.jit(lambda t: t * 2)
+
+        def serve(requests):
+            for prompt in requests:
+                decode_fn(  # hvd-lint: disable=HVD109
+                    jnp.zeros((len(prompt),), jnp.int32))
+    """) == []
+
+
+# ---------------------------------------------------------------------------
 # Suppression + driver behaviour
 # ---------------------------------------------------------------------------
 
