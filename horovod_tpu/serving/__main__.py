@@ -119,6 +119,12 @@ def _serve_fleet(serving: ServingEngine, w: loadgen.Workload,
     from horovod_tpu.core.engine import OP_ALLREDUCE
 
     arrivals = loadgen.make_arrivals(w)
+    # Rank 0 runs the live autoscale policy over the tick aggregates;
+    # verdicts land as AUTOSCALE timeline instants and one stdout line
+    # each, which the supervisor holding the fleet (run.py, an operator)
+    # acts on by launching a joiner / retiring a seat.
+    auto = autoscale.Autoscaler(autoscale.AutoscaleConfig.from_env(),
+                                collective=serving.collective)
     t0 = serving.clock()
     done, i = [], 0
     drained_h = None
@@ -133,6 +139,15 @@ def _serve_fleet(serving: ServingEngine, w: loadgen.Workload,
         serving.done_flag = 1.0 if mine_done else 0.0
         try:
             done.extend(serving.step())
+            if serving.collective.rank == 0 and not mine_done:
+                verdict = auto.decide(
+                    replicas=serving.collective.size,
+                    queued=serving.fleet.get("queued", 0.0),
+                    active_slots=serving.fleet.get("active", 0.0),
+                    p99_ttft_ms=serving.stats()["ttft_p99_ms"])
+                if verdict is not None:
+                    print(f"AUTOSCALE {verdict} "
+                          f"replicas={serving.collective.size}", flush=True)
             if mine_done and drained_h is None:
                 drained_h = serving.collective.enqueue(
                     "serving.drained", np.zeros(1, np.float32),
@@ -144,6 +159,7 @@ def _serve_fleet(serving: ServingEngine, w: loadgen.Workload,
         except MembershipChanged:
             ev = elastic.reconfigure()
             serving.collective = em.peek_engine()
+            auto.collective = serving.collective
             drained_h = None  # handle belonged to the replaced engine
             if ev.grew and serving.collective.rank == ev.new_size - 2:
                 autoscale.ship_weights(serving.collective, ev.new_size - 1,

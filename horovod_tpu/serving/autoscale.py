@@ -7,10 +7,14 @@ Two halves, both riding machinery that already exists:
   every replica (queue depth, p99 TTFT): GROW when the backlog per
   replica or the tail latency crosses its threshold, SHRINK after a
   sustained idle window, both rate-limited by a cooldown and clamped to
-  ``[min_replicas, max_replicas]``.  The policy only *decides*; acting is
-  the supervisor's job (serving/soak.py spawns a joiner process,
-  ``run.py --serve`` relaunches ranks), which keeps the policy
-  deterministic and testable without processes.
+  ``[min_replicas, max_replicas]``.  Rank 0 of a serving fleet runs
+  :meth:`Autoscaler.decide` every tick (serving/worker.py and the
+  ``run.py --serve`` loop in serving/__main__.py) and publishes each
+  verdict as an AUTOSCALE timeline instant plus one ``AUTOSCALE grow`` /
+  ``shrink`` stdout line.  The policy only *decides*; acting is the
+  supervisor's job — the soak driver (serving/soak.py) spawns the joiner
+  process on a GROW verdict, ``run.py`` relaunches dead seats — which
+  keeps the policy deterministic and testable without processes.
 
 * **Weight motion** — a freshly joined replica pulls the model from a
   ring neighbor's host memory over the PR-11 bulk data plane instead of

@@ -245,6 +245,10 @@ class ServingEngine:
         # (a replica must keep ticking until ALL replicas drain — stopping
         # early would stall the others' collective).
         self.done_flag = 0.0
+        # Completions whose step() return was swallowed by a
+        # MembershipChanged out of the collective tick — handed to the
+        # caller on the next successful step (see step()).
+        self._undelivered: list[Request] = []
         _ACTIVE = self
 
     # -- request intake ---------------------------------------------------
@@ -285,10 +289,23 @@ class ServingEngine:
                 if req.state == "DONE":
                     self._evict(req, s, done)
         self.counters["steps"] += 1
-        self._tick_collective()
+        # Deliver completions BEFORE the collective tick: enqueue /
+        # synchronize raise MembershipChanged on a reconfiguration, and a
+        # request already evicted from its slot but not yet reported would
+        # otherwise vanish — a survivor's dropped DONE is a permanently
+        # lost response (the soak only retries the killed replica's rids).
         if self.on_complete:
             for req in done:
                 self.on_complete(req)
+        done = self._undelivered + done
+        self._undelivered = []
+        try:
+            self._tick_collective()
+        except BaseException:
+            # Aborted tick: the caller never sees this step's return
+            # value, so park the completions for the next step.
+            self._undelivered = done
+            raise
         return done
 
     def _admit(self, done: list[Request]) -> None:
@@ -374,6 +391,8 @@ class ServingEngine:
         out: list[Request] = []
         for _ in range(max_steps):
             if not self.queue and self._active_count() == 0:
+                out.extend(self._undelivered)  # parked by an aborted tick
+                self._undelivered = []
                 return out
             out.extend(self.step())
         raise RuntimeError("serving engine did not drain "

@@ -5,10 +5,15 @@ replicas, no jax), drives the deterministic Poisson workload at them
 round-robin, and injects the two events the autoscaler story must
 survive:
 
-* **grow under load** — a joiner process is admitted mid-traffic via the
-  JOIN/RECONFIG machinery and pulls the weights from its ring neighbor
-  over the bulk data plane; the driver asserts the pulled CRC matches
-  and ``disk_reads=0`` (the blob never touched a filesystem).
+* **grow under load** — rank 0 runs the live :class:`Autoscaler` policy
+  over its serving.tick aggregates (aggressive thresholds, set below, so
+  the bursty Poisson load can trip it) and prints ``AUTOSCALE grow``;
+  this driver is the supervisor that acts on the verdict, spawning a
+  joiner that is admitted mid-traffic via the JOIN/RECONFIG machinery
+  and pulls the weights from its ring neighbor over the bulk data plane
+  (the driver asserts the pulled CRC matches and ``disk_reads=0``).  A
+  fallback deadline backstops the policy — the chaos leg must exercise
+  the join deterministically even when the offered load never queues.
 * **SIGKILL mid-traffic** — one replica dies hard; every request it had
   accepted but not completed is resubmitted to a survivor, and because
   the token automaton is deterministic the retried completion is
@@ -96,6 +101,19 @@ class _Replica:
                     return None
                 self._cv.wait(min(left, 0.1))
 
+    def wait_eof(self, timeout_s: float) -> None:
+        """Block until the pump thread hit EOF — after SIGKILL +
+        ``proc.wait()`` a DONE the victim delivered just before dying may
+        still sit in the pipe, and reading ``done_rids()`` early would
+        resubmit (double-execute) an already-completed request."""
+        deadline = time.monotonic() + timeout_s
+        with self._cv:
+            while self.alive:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return
+                self._cv.wait(min(left, 0.1))
+
     def done_rids(self) -> dict[int, str]:
         out = {}
         with self._cv:
@@ -115,7 +133,14 @@ def run_fleet(n: int = 2, qps: float = 40.0, duration_s: float = 4.0,
     t_start = time.monotonic()
     port = _free_port()
     env = {**os.environ, **FLEET_ENV, "PYTHONPATH": _REPO,
-           "JAX_PLATFORMS": "cpu", "HVD_TPU_SERVE_STEP_S": str(step_s)}
+           "JAX_PLATFORMS": "cpu", "HVD_TPU_SERVE_STEP_S": str(step_s),
+           # Aggressive autoscale thresholds: the soak's load is light
+           # (the point is chaos, not saturation), so give rank 0's live
+           # policy a realistic chance of tripping GROW on a Poisson
+           # burst; the fallback deadline below covers the quiet case.
+           "HVD_TPU_SERVE_QUEUE_HIGH": "2",
+           "HVD_TPU_SERVE_P99_MS": "25",
+           "HVD_TPU_SERVE_COOLDOWN_S": "0.5"}
     argv = [sys.executable, "-m", "horovod_tpu.serving.worker"]
     fleet = [_Replica(argv + [str(r), str(n), str(port)], env)
              for r in range(n)]
@@ -129,34 +154,42 @@ def run_fleet(n: int = 2, qps: float = 40.0, duration_s: float = 4.0,
                              vocab=worker_mod.VOCAB)
         arrivals = loadgen.make_arrivals(w)
         assert arrivals, "workload produced no arrivals"
-        join_at = duration_s * 0.3 if join else None
+        join_pending = join
+        join_fallback = duration_s * 0.3
         kill_at = duration_s * 0.6 if kill else None
         owner: dict[int, int] = {}
         expect: dict[int, int] = {}
         retried_rids: set[int] = set()
         joiner = None
+        join_spawned_at = None
         killed_idx = None
         t0 = time.monotonic()
         i = 0
         rr = 0
         join_ms = None
-        while i < len(arrivals) or (join_at is not None) \
-                or (kill_at is not None):
+        while i < len(arrivals) or join_pending or (kill_at is not None):
             now = time.monotonic() - t0
-            if join_at is not None and now >= join_at:
-                join_at = None
-                joiner = _Replica(argv + ["--join", str(port)], env)
-                fleet.append(joiner)
+            if join_pending:
+                # The supervisor half of the autoscaler: grow when rank
+                # 0's live policy says so, else at the fallback deadline
+                # (the soak must exercise the join path every run).
+                grow = fleet[0].wait_line("AUTOSCALE grow", 0.0)
+                if grow is not None or now >= join_fallback:
+                    join_pending = False
+                    join_spawned_at = now
+                    joiner = _Replica(argv + ["--join", str(port)], env)
+                    fleet.append(joiner)
             if joiner is not None and join_ms is None:
                 line = joiner.wait_line("READY", 0.0)
                 if line is not None:
-                    join_ms = (time.monotonic() - t0 - duration_s * 0.3) * 1e3
+                    join_ms = (time.monotonic() - t0 - join_spawned_at) * 1e3
             if kill_at is not None and now >= kill_at:
                 kill_at = None
                 killed_idx = n - 1  # never rank 0: that seat coordinates
                 victim = fleet[killed_idx]
                 victim.proc.send_signal(signal.SIGKILL)
                 victim.proc.wait(timeout=10)
+                victim.wait_eof(10)  # pipe may outlive the process
                 done = victim.done_rids()
                 live = [r for j, r in enumerate(fleet)
                         if j != killed_idx and r.alive]

@@ -20,7 +20,18 @@ worker -> parent::
     SWAPPED version=.. crc=..         hot-swap applied between steps
     DONE <rid> ntok=.. crc=.. reason=..       request completed
     RECONFIGURED epoch=.. size=..     survived a membership change
+    AUTOSCALE grow|shrink ...         rank 0: live policy verdict — the
+                                      supervisor (soak driver) acts on it
     STATS {...}
+
+On QUIT a worker does NOT exit as soon as its own queue drains — peers
+may still be ticking the fixed ``serving.tick`` allreduce, and a replica
+that stops early stalls their collective until heartbeat death kicks in.
+Instead it keeps ticking with ``done_flag`` raised and announces a
+one-shot polled ``serving.drained`` collective (the same rendezvous
+``_serve_fleet`` uses): the coordinator dispatches it only once every
+replica has announced, so the whole fleet breaks out after the same
+tick.
 
 Founding mode: argv = ``rank n coordinator_port``; join mode: argv =
 ``--join coordinator_port``.  On a grow reconfiguration the survivor
@@ -42,7 +53,8 @@ import numpy as np
 
 from horovod_tpu import elastic, replication
 from horovod_tpu.core import engine as em
-from horovod_tpu.core.engine import MembershipChanged, NativeEngine
+from horovod_tpu.core.engine import (OP_ALLREDUCE, MembershipChanged,
+                                     NativeEngine)
 from horovod_tpu.core.executors import local_executor
 from horovod_tpu.serving import autoscale
 from horovod_tpu.serving.engine import (ServingConfig, ServingEngine,
@@ -141,8 +153,14 @@ def main(argv=None) -> int:
             f"crc={completion_crc(r.tokens)} reason={r.finish_reason}"))
     cmds: "queue.Queue[str]" = queue.Queue()
     threading.Thread(target=_reader, args=(cmds,), daemon=True).start()
+    # The live autoscale policy: rank 0 feeds it the serving.tick
+    # aggregates every tick and prints its verdicts; the supervisor
+    # holding the fleet (soak driver) does the spawning/retiring.
+    auto = autoscale.Autoscaler(autoscale.AutoscaleConfig.from_env(),
+                                collective=eng)
     _say(f"READY rank={eng.rank} size={eng.size} epoch={eng.epoch}")
     quitting = False
+    drained_h = None
     while True:
         try:
             cmd = cmds.get(timeout=0.002)
@@ -165,9 +183,31 @@ def main(argv=None) -> int:
             serving.submit([int(t) for t in toks.split(",")],
                            int(max_new), rid=int(rid.rstrip("R")),
                            retry=retry)
+        mine_done = quitting and not serving.queue \
+            and not serving._active_count()
+        serving.done_flag = 1.0 if mine_done else 0.0
         try:
-            if serving.queue or serving._active_count() or not quitting:
-                serving.step()
+            # Always tick — a drained replica that stopped stepping would
+            # stall its peers' serving.tick allreduce (engine.done_flag
+            # comment); the fleet leaves together via serving.drained.
+            serving.step()
+            if mine_done and drained_h is None:
+                drained_h = serving.collective.enqueue(
+                    "serving.drained", np.zeros(1, np.float32),
+                    OP_ALLREDUCE)
+            if drained_h is not None and serving.collective.poll(drained_h):
+                serving.collective.synchronize(drained_h)
+                break
+            if eng.rank == 0 and not quitting:
+                verdict = auto.decide(
+                    replicas=eng.size,
+                    queued=serving.fleet.get("queued",
+                                             float(len(serving.queue))),
+                    active_slots=serving.fleet.get(
+                        "active", float(serving._active_count())),
+                    p99_ttft_ms=serving.stats()["ttft_p99_ms"])
+                if verdict is not None:
+                    _say(f"AUTOSCALE {verdict} replicas={eng.size}")
             swap = autoscale.poll_weights(eng, version)
             if swap is not None:
                 version, state = swap["step"], swap["state"]
@@ -176,6 +216,8 @@ def main(argv=None) -> int:
             ev = elastic.reconfigure()
             eng = em.peek_engine()
             serving.collective = eng
+            auto.collective = eng
+            drained_h = None  # handle belonged to the replaced engine
             _say(f"RECONFIGURED epoch={ev.epoch} size={ev.new_size}")
             if ev.grew and eng.rank == ev.new_size - 2:
                 # I'm the joiner's ring neighbor: donate the weights.
@@ -183,8 +225,8 @@ def main(argv=None) -> int:
                                              state)
                 _say(f"SHIPPED dst={ev.new_size - 1} version={version} "
                      f"via={via}")
-        if quitting and not serving.queue and not serving._active_count():
-            break
+        if mine_done:
+            time.sleep(0.001)
     _say(f"STATS {serving.stats()!r}")
     eng.shutdown()
     return 0

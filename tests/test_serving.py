@@ -145,6 +145,47 @@ def test_serving_stats_accessor(monkeypatch):
     assert live["ttft_p50_ms"] >= 0.0 and live["active_slots"] == 0
 
 
+def test_completions_survive_aborted_tick():
+    # A reconfiguration aborts the serving.tick allreduce with
+    # MembershipChanged AFTER the step's evictions.  The completion must
+    # still reach on_complete (the worker's DONE line — the soak's
+    # no-lost-request proof) and the step() return value must not vanish:
+    # it is parked and handed over by the next successful step.
+    from horovod_tpu.core.engine import MembershipChanged
+
+    class _FlakyCollective:
+        def __init__(self):
+            self.blow = False
+
+        def timeline_instant(self, *a, **k):
+            pass
+
+        def enqueue(self, name, vec, op):
+            if self.blow:
+                self.blow = False
+                raise MembershipChanged("reconfig mid-tick")
+            return "h"
+
+        def synchronize(self, h):
+            return np.zeros(9, np.float32)
+
+    coll = _FlakyCollective()
+    seen: list[Request] = []
+    eng = ServingEngine(StubBackend(1), ServingConfig(
+        num_slots=1, buckets=(8,), max_seq_len=64), collective=coll,
+        on_complete=seen.append)
+    req = eng.submit([1, 2, 3], 1)  # completes on its admission step
+    coll.blow = True
+    with pytest.raises(MembershipChanged):
+        eng.step()
+    assert [r.rid for r in seen] == [req.rid]  # delivered before the tick
+    assert eng._active_count() == 0  # evicted — the slot really freed
+    nxt = eng.submit([4, 5], 1)
+    done = eng.step()  # post-reconfigure step flushes the parked request
+    assert [r.rid for r in done] == [req.rid, nxt.rid]
+    assert [r.rid for r in seen] == [req.rid, nxt.rid]  # no double DONE
+
+
 # ---------------------------------------------------------------------------
 # TransformerBackend: the real-model KV-cache decode path
 # ---------------------------------------------------------------------------
