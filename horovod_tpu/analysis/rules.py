@@ -787,6 +787,75 @@ class UnbucketedServeShape(Rule):
         return out
 
 
+class CollectiveBeforeReconfigure(Rule):
+    """Collective issued inside ``except MembershipChanged:`` before
+    ``elastic.reconfigure()``.
+
+    ``MembershipChanged`` (elastic.py) means the membership epoch just
+    bumped: the engine that raised it is stopping, every in-flight
+    collective is failing, and any frame stamped with the old epoch is
+    rejected as ``stale_epoch`` by the new control plane (message.h
+    FrameHeader).  Retrying the collective from the handler therefore
+    hangs or aborts — the protocol model checker derives the wedge
+    mechanically (analysis/protocol: the RECONFIG-in-wait interleavings).
+    The contract is the serving/worker.py shape: call
+    ``elastic.reconfigure()`` FIRST (it re-forms the control plane under
+    the new epoch and returns the resize event), rebuild per-epoch state,
+    then re-issue work.  Handlers that only clean up and re-raise are
+    fine; only collectives issued before any ``reconfigure()`` call in
+    the same handler are flagged.
+    """
+
+    code = "HVD110"
+    name = "collective-before-reconfigure"
+    hint = ("call elastic.reconfigure() before issuing collectives from a "
+            "MembershipChanged handler (it re-forms the control plane "
+            "under the new epoch; old-epoch frames are rejected as "
+            "stale_epoch), then rebuild per-epoch state and retry")
+
+    # The engine-level enqueue is how serving/background loops issue work
+    # without the public wrappers; it speaks the same stale-epoch protocol.
+    _RETRY_CALLS = COLLECTIVE_CALLS | {"enqueue"}
+
+    def _catches_membership_changed(self, handler: ast.ExceptHandler) -> bool:
+        if handler.type is None:
+            return False
+        types = (handler.type.elts if isinstance(handler.type, ast.Tuple)
+                 else [handler.type])
+        for ty in types:
+            path = dotted(ty)
+            if path is not None and \
+                    path.split(".")[-1] == "MembershipChanged":
+                return True
+        return False
+
+    def run(self, ctx: Context) -> list[Finding]:
+        out: list[Finding] = []
+        for node in ast.walk(ctx.module):
+            if not isinstance(node, ast.Try):
+                continue
+            for handler in node.handlers:
+                if not self._catches_membership_changed(handler):
+                    continue
+                calls = [c for stmt in handler.body
+                         for c in ast.walk(stmt) if isinstance(c, ast.Call)]
+                calls.sort(key=lambda c: (c.lineno, c.col_offset))
+                reconfigured = False
+                for c in calls:
+                    cname = call_name(c)
+                    if cname == "reconfigure":
+                        reconfigured = True
+                    elif cname in self._RETRY_CALLS and not reconfigured:
+                        out.append(self.finding(c, (
+                            f"'{cname}' issued inside an "
+                            f"'except MembershipChanged' handler before "
+                            f"elastic.reconfigure(): the epoch just "
+                            f"bumped, so the retry's frames are rejected "
+                            f"as stale_epoch by the new control plane "
+                            f"(or hang against the stopping engine)")))
+        return out
+
+
 RULES: list[Rule] = [
     RankDivergentCollective(),
     UnnamedCollectiveInLoop(),
@@ -797,4 +866,5 @@ RULES: list[Rule] = [
     HandTunedOverlapKnob(),
     HandTunedContextLayout(),
     UnbucketedServeShape(),
+    CollectiveBeforeReconfigure(),
 ]

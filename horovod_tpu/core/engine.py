@@ -196,6 +196,9 @@ def _load_library() -> ctypes.CDLL:
     lib.hvd_release.restype = ctypes.c_int
     lib.hvd_release.argtypes = [ctypes.c_void_p, ctypes.c_longlong,
                                 ctypes.c_char_p, ctypes.c_int]
+    lib.hvd_frame_golden.restype = ctypes.c_int
+    lib.hvd_frame_golden.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                     ctypes.c_int]
     for name in ("hvd_half_to_float", "hvd_float_to_half",
                  "hvd_bf16_to_float", "hvd_float_to_bf16"):
         fn = getattr(lib, name)
@@ -214,6 +217,21 @@ def lib() -> ctypes.CDLL:
         if _lib is None:
             _lib = _load_library()
         return _lib
+
+
+def frame_golden(frame_type: int) -> bytes:
+    """The native golden wire vector for ``frame_type`` (c_api.cc
+    hvd_frame_golden): complete framed bytes with canonical field values.
+    Conformance anchor for horovod_tpu/analysis/protocol/wire.py and the
+    tests/golden/frames/ fixtures; raises for an unknown frame type."""
+    buf = ctypes.create_string_buffer(1 << 16)
+    n = lib().hvd_frame_golden(frame_type, buf, len(buf))
+    if n == 0:
+        raise ValueError(f"no golden frame for type {frame_type}")
+    if n < 0:  # grow-and-retry convention (-needed-1)
+        buf = ctypes.create_string_buffer(-n - 1)
+        n = lib().hvd_frame_golden(frame_type, buf, len(buf))
+    return buf.raw[:n]
 
 
 class ExecBatch:

@@ -11,6 +11,7 @@
 
 #include "engine.h"
 #include "half.h"
+#include "message.h"
 #include "tree.h"
 
 using hvd::DataType;
@@ -56,6 +57,243 @@ bool EnvFlag(const char* horovod_name, const char* hvd_tpu_name) {
   if (v == nullptr || *v == '\0') return false;
   return std::strcmp(v, "0") != 0 && std::strcmp(v, "false") != 0 &&
          std::strcmp(v, "False") != 0;
+}
+
+// The canonical ResponseList (shared by the RESPONSE golden frame and the
+// AGG_STATE golden frame's embedded response bytes).
+hvd::ResponseList GoldenResponseList() {
+  hvd::ResponseList rl;
+  hvd::Response a;
+  a.cache_bit = 5;  // cache hit: nothing else serialized
+  hvd::Response b;
+  b.type = hvd::Response::Type::ALLGATHER;
+  b.tensor_names = {"metrics.gather", "agg.y"};
+  b.first_dim_sizes = {3, 5};
+  b.store_bit = 2;
+  hvd::Response c;
+  c.type = hvd::Response::Type::ERROR;
+  c.tensor_names = {"grad/dense/kernel:0"};
+  c.error_reason = "peer failure: rank 2";
+  rl.responses = {a, b, c};
+  hvd::DivergenceEntry de;
+  de.rank = 1;
+  de.seq = 9;
+  de.hash = 0xDEADBEEF12345678ull;
+  de.desc = "allreduce step.9";
+  rl.divergence = {de};
+  rl.cache_invalidate = {"stale.tensor"};
+  return rl;
+}
+
+// Canonical golden wire samples — the byte-for-byte conformance anchor
+// between this file's serializers (message.cc) and the Python protocol
+// mirror (horovod_tpu/analysis/protocol/wire.py golden_frames()).  Both
+// sides hard-code the SAME field values; tests/golden/frames/ holds the
+// framed bytes and tests/test_protocol_model.py pins all three against
+// each other.  Change a value here only together with its Python twin
+// and regenerated fixtures.
+std::string GoldenFrame(int frame_type) {
+  using hvd::FrameType;
+  std::string payload;
+  int64_t epoch = 0;
+  switch (static_cast<FrameType>(frame_type)) {
+    case FrameType::HELLO: {
+      Writer w;
+      w.i32(3);      // rank
+      w.i32(18443);  // standby_listen_port
+      w.i32(19001);  // bulk_listen_port
+      payload = w.buf;
+      break;
+    }
+    case FrameType::HELLO_ACK:
+      break;  // empty = accepted
+    case FrameType::REQUEST: {
+      hvd::RequestList rl;
+      hvd::Request r1;
+      r1.rank = 1;
+      r1.op = hvd::OpType::ALLREDUCE;
+      r1.dtype = DataType::FLOAT32;
+      r1.root_rank = -1;
+      r1.wire = hvd::WireFormat::NATIVE;
+      r1.name = "grad/dense/kernel:0";
+      r1.shape.dims = {4, 8};
+      hvd::Request r2;
+      r2.rank = 1;
+      r2.op = hvd::OpType::ALLGATHER;
+      r2.dtype = DataType::INT64;
+      r2.root_rank = 0;
+      r2.wire = hvd::WireFormat::INT8;
+      r2.name = "metrics.gather";
+      r2.shape.dims = {3};
+      rl.requests = {r1, r2};
+      hvd::VerifyEntry ve;
+      ve.seq = 7;
+      ve.hash = 0x1234567890ABCDEFull;
+      ve.desc = "allreduce grad/dense/kernel:0";
+      rl.verify = {ve};
+      rl.cache_hits = {0, 3, 9};
+      rl.cache_invalidate = {"stale.tensor"};
+      hvd::Serialize(rl, &payload);
+      epoch = 2;
+      break;
+    }
+    case FrameType::RESPONSE: {
+      hvd::Serialize(GoldenResponseList(), &payload);
+      epoch = 2;
+      break;
+    }
+    case FrameType::HEARTBEAT:
+      epoch = 2;
+      break;  // empty liveness frame
+    case FrameType::ABORT: {
+      hvd::PeerFailureReport pf;
+      pf.failed_rank = 2;
+      pf.cause = "heartbeat_timeout";
+      pf.detail = "silence 11000 ms";
+      pf.last_heard_us = 11000000;
+      pf.last_collective = "allreduce grad/dense/kernel:0";
+      hvd::Serialize(pf, &payload);
+      epoch = 2;
+      break;
+    }
+    case FrameType::RECONFIG: {
+      hvd::ReconfigInfo ri;
+      ri.epoch = 3;
+      ri.new_size = 3;
+      ri.failed_rank = 1;
+      ri.cause = "connection_reset";
+      ri.new_ranks = {0, -1, 1, 2};
+      hvd::Serialize(ri, &payload);
+      epoch = 3;
+      break;
+    }
+    case FrameType::JOIN: {
+      Writer w;
+      w.i32(2);  // id
+      payload = w.buf;
+      break;
+    }
+    case FrameType::JOIN_ACK: {
+      hvd::JoinTicket jt;
+      jt.epoch = 4;
+      jt.new_size = 4;
+      jt.assigned_rank = 3;
+      hvd::Serialize(jt, &payload);
+      break;
+    }
+    case FrameType::STANDBY: {
+      hvd::StandbyInfo si;
+      si.standby_rank = 1;
+      si.host = "127.0.0.1";
+      si.port = 23456;
+      hvd::Serialize(si, &payload);
+      break;
+    }
+    case FrameType::STATE: {
+      hvd::CoordState cs;
+      cs.epoch = 3;
+      cs.joins_admitted = 1;
+      cs.verify_checked = 42;
+      cs.verify_tick = 7;
+      cs.lru_order = {2, 0, 1};
+      hvd::Serialize(cs, &payload);
+      epoch = 3;
+      break;
+    }
+    case FrameType::SHARD_PUT: {
+      hvd::ShardPut sp;
+      sp.owner_rank = 1;
+      sp.target_rank = 2;
+      sp.step = 10;
+      sp.epoch = 3;
+      sp.payload = std::string("\x00\x01\x02\x03shard-bytes", 15);
+      hvd::Serialize(sp, &payload);
+      epoch = 3;
+      break;
+    }
+    case FrameType::SHARD_ACK: {
+      hvd::ShardAck sa;
+      sa.owner_rank = 1;
+      sa.target_rank = 2;
+      sa.step = 10;
+      sa.epoch = 3;
+      hvd::Serialize(sa, &payload);
+      epoch = 3;
+      break;
+    }
+    case FrameType::TICKET_REQ: {
+      hvd::TicketRequest tr;
+      tr.src_rank = 1;
+      tr.dst_rank = 2;
+      tr.step = 10;
+      tr.epoch = 3;
+      tr.nbytes = 4096;
+      tr.manifest = "{\"cut\":2}";
+      hvd::Serialize(tr, &payload);
+      epoch = 3;
+      break;
+    }
+    case FrameType::TICKET: {
+      hvd::Ticket t;
+      t.transfer_id = 99;
+      t.token = hvd::BulkToken(99, 3, 1, 2);
+      t.src_rank = 1;
+      t.dst_rank = 2;
+      t.dst_host = "127.0.0.1";
+      t.dst_port = 20001;
+      t.step = 10;
+      t.epoch = 3;
+      t.manifest = "{\"cut\":2}";
+      hvd::Serialize(t, &payload);
+      epoch = 3;
+      break;
+    }
+    case FrameType::AGG_REQUEST: {
+      hvd::AggRequestList al;
+      al.agg_id = 1;
+      al.seq = 5;
+      al.members = {3, 4};
+      al.hits_all = {1, 2};
+      al.verify_folded = true;
+      hvd::VerifyEntry ve;
+      ve.seq = 5;
+      ve.hash = 0x0123456789ABCDEFull;
+      ve.desc = "fold";
+      al.verify_all = {ve};
+      hvd::RequestList res0;
+      hvd::Request r;
+      r.rank = 3;
+      r.op = hvd::OpType::ALLREDUCE;
+      r.dtype = DataType::FLOAT32;
+      r.root_rank = -1;
+      r.wire = hvd::WireFormat::NATIVE;
+      r.name = "grad/dense/kernel:0";
+      r.shape.dims = {4, 8};
+      res0.requests = {r};
+      al.residual = {res0, hvd::RequestList()};
+      hvd::Serialize(al, &payload);
+      epoch = 2;
+      break;
+    }
+    case FrameType::AGG_STATE: {
+      hvd::AggState as;
+      as.seq = 5;
+      hvd::Serialize(GoldenResponseList(), &as.response);
+      hvd::Serialize(as, &payload);
+      epoch = 2;
+      break;
+    }
+    default:
+      return std::string();  // unknown type: caller sees 0 bytes
+  }
+  hvd::FrameHeader h;
+  h.type = static_cast<uint8_t>(frame_type);
+  h.flags = static_cast<uint16_t>(epoch & 0xFFFF);
+  h.payload_len = static_cast<uint32_t>(payload.size());
+  h.crc32 = hvd::Crc32(payload.data(), payload.size());
+  char hdr[hvd::kFrameHeaderBytes];
+  hvd::EncodeFrameHeader(h, hdr);
+  return std::string(hdr, hvd::kFrameHeaderBytes) + payload;
 }
 
 }  // namespace
@@ -533,6 +771,22 @@ int hvd_release(void* e, long long handle, char* reason, int rlen) {
   Status s = static_cast<Engine*>(e)->ReleaseHandle(handle);
   CopyErr(s.reason, reason, rlen);
   return static_cast<int>(s.type);
+}
+
+// Golden wire vector for one FrameType (1..17): the complete framed bytes
+// (FrameHeader + payload) with the canonical field values hard-coded
+// above.  Conformance hook for horovod_tpu/analysis/protocol/wire.py and
+// the tests/golden/frames/ fixtures — NOT used by the runtime.  Returns
+// bytes written, 0 for an unknown type, or -needed-1 when buflen is too
+// small (hvd_next_batch's grow-and-retry convention).
+int hvd_frame_golden(int frame_type, char* buf, int buflen) {
+  std::string framed = GoldenFrame(frame_type);
+  if (framed.empty()) return 0;
+  if (static_cast<int>(framed.size()) > buflen) {
+    return -static_cast<int>(framed.size()) - 1;
+  }
+  std::memcpy(buf, framed.data(), framed.size());
+  return static_cast<int>(framed.size());
 }
 
 // fp16/bf16 host converters (half.h) for the torch/numpy staging paths.

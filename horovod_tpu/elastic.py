@@ -57,6 +57,15 @@ jobs should keep ``HVD_TPU_ELASTIC=0`` and the PR-1 full-restart story.
 
 jax-free by design: joiners and engine-only workers must reach their
 rendezvous without paying the jax import.
+
+The succession and admission protocol here (promotion epoch bumps,
+synchronous replication of the epoch/join counters before a verdict is
+externalized, stale-epoch fencing of STATE deltas, single-use JOIN
+tickets with idempotent re-issue on retry) is modeled and exhaustively
+checked by ``horovod_tpu/analysis/protocol`` (``ElasticModel``); see
+docs/static_analysis.md "Protocol model checking".  A behavior change
+here should change that model first — the checker finds the
+interleaving that breaks the weaker rule.
 """
 
 from __future__ import annotations

@@ -17,6 +17,13 @@ subprocesses) that must not pay the jax import.  Every backend op is
 batch-row-independent, which is what makes continuous batching *safe*:
 a sequence's logits in a mixed batch are bit-identical to the same
 sequence decoded alone through the same-shaped program.
+
+The fleet-level protocol around this engine (completion delivery across
+RECONFIG, protocol-driven drain on QUIT) is model-checked by
+``horovod_tpu/analysis/protocol`` (``ServingDrainModel``), which
+re-derives both historical serving bugs from pre-fix models as pinned
+regression traces — see docs/static_analysis.md "Protocol model
+checking" and tests/golden/traces/.
 """
 
 from __future__ import annotations

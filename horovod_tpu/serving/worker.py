@@ -38,6 +38,14 @@ Founding mode: argv = ``rank n coordinator_port``; join mode: argv =
 that is the joiner's ring neighbor donates the current weights over the
 bulk data plane (autoscale.ship_weights) — the joiner reports
 ``disk_reads=0`` because the blob never touched a filesystem.
+
+Both drain rules above (deliver parked completions before re-forming on
+RECONFIG; exit only on the protocol-wide ``serving.drained`` verdict,
+never on a locally-drained queue) were each once bugs, and are now
+invariants of ``ServingDrainModel`` in ``horovod_tpu/analysis/protocol``
+— the model checker re-derives both counterexamples from the pre-fix
+flags (tests/golden/traces/), so a regression here fails ``make
+modelcheck`` at the model level and pytest at the trace level.
 """
 
 from __future__ import annotations

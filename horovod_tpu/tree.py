@@ -18,6 +18,14 @@ Topology (depth 2, docs/benchmarks.md "Control-plane scaling")::
 Rank 0 stays the negotiating coordinator; workers 1..size-1 split into
 contiguous groups of ``fanout``.  Below the activation threshold the plan
 is inactive and the engine runs the existing rank-0 star bit-for-bit.
+
+The relay tier's transition rules — AGG_STATE replication ordering
+(relay replicates before fan-out, root before dispatch), standby replay
+of a stale root response, duplicate-broadcast discard, and held-response
+GC — live as a checked model in ``horovod_tpu/analysis/protocol``
+(``TreeModel``): the spec the native tree implementation must satisfy,
+verified under relay/root crash interleavings before the C++ exists.
+See docs/static_analysis.md "Protocol model checking".
 """
 
 from __future__ import annotations

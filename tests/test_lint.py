@@ -480,6 +480,86 @@ def test_hvd109_suppressible_for_one_shape_fixtures():
 
 
 # ---------------------------------------------------------------------------
+# HVD110 — collective before reconfigure in a MembershipChanged handler
+# ---------------------------------------------------------------------------
+
+def test_hvd110_retry_without_reconfigure():
+    assert codes("""
+        import horovod_tpu as hvd
+        from horovod_tpu.elastic import MembershipChanged
+
+        def step(x):
+            try:
+                return hvd.allreduce(x)
+            except MembershipChanged:
+                return hvd.allreduce(x)
+    """) == ["HVD110"]
+
+
+def test_hvd110_engine_enqueue_and_dotted_exception():
+    # The engine-level verb and a dotted exception path both count; two
+    # pre-reconfigure issues -> two findings.
+    assert codes("""
+        import horovod_tpu as hvd
+
+        def pump(engine, x):
+            try:
+                engine.enqueue("t", 0, 5, -1, 0, x)
+            except hvd.elastic.MembershipChanged:
+                engine.enqueue("t", 0, 5, -1, 0, x)
+                hvd.barrier()
+    """) == ["HVD110", "HVD110"]
+
+
+def test_hvd110_clean_reconfigure_first():
+    # The sanctioned serving/worker.py shape: reconfigure, rebuild, retry.
+    assert codes("""
+        import horovod_tpu as hvd
+        from horovod_tpu import elastic
+        from horovod_tpu.elastic import MembershipChanged
+
+        def step(x):
+            try:
+                return hvd.allreduce(x)
+            except MembershipChanged:
+                ev = elastic.reconfigure()
+                return hvd.allreduce(x)
+    """) == []
+
+
+def test_hvd110_clean_cleanup_only_handler_and_other_exceptions():
+    assert codes("""
+        import horovod_tpu as hvd
+        from horovod_tpu.elastic import MembershipChanged
+
+        def step(x, log):
+            try:
+                return hvd.allreduce(x)
+            except MembershipChanged:
+                log.warning("resized")
+                raise
+            except ValueError:
+                return hvd.allreduce(x)
+    """) == []
+
+
+def test_hvd110_tuple_exception_type_and_suppression():
+    src = """
+        import horovod_tpu as hvd
+        from horovod_tpu.elastic import MembershipChanged
+
+        def step(x):
+            try:
+                return hvd.allreduce(x)
+            except (MembershipChanged, RuntimeError):
+                return hvd.allreduce(x)  # hvd-lint: disable=HVD110
+    """
+    assert codes(src) == []
+    assert codes(src.replace("  # hvd-lint: disable=HVD110", "")) \
+        == ["HVD110"]
+
+
+# ---------------------------------------------------------------------------
 # Suppression + driver behaviour
 # ---------------------------------------------------------------------------
 

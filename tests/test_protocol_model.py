@@ -1,0 +1,331 @@
+"""Protocol model checker: conformance, counterexamples, and invariants.
+
+Three layers of pinning (analysis/protocol):
+
+1. **Wire conformance** — the Python frame grammar (wire.py) must be
+   byte-identical to core/src/message.cc: golden vectors for every
+   FrameType checked against the fixtures in tests/golden/frames/ AND
+   against the native encoder (c_api.cc hvd_frame_golden) when the
+   library is built.
+2. **Counterexample teeth** — the checker must re-derive both PR-14 bugs
+   from the pre-fix model flags (the regression traces in
+   tests/golden/traces/), and every elastic/tree bug knob must produce
+   its named violation.
+3. **Spec sweeps** — the fixed models must pass exhaustively: the
+   serving composition with >= 10^4 distinct states, the elastic
+   succession model, and the item-3 tree spec, plus deterministic
+   seeded walks.
+"""
+
+import json
+import os
+
+import pytest
+
+from horovod_tpu.analysis.protocol import wire
+from horovod_tpu.analysis.protocol.checker import (check_bfs, check_walk,
+                                                   frames_in_trace,
+                                                   replay_trace)
+from horovod_tpu.analysis.protocol.machines import (ElasticModel,
+                                                    ServingDrainModel,
+                                                    TreeModel)
+from horovod_tpu.analysis.protocol.replay import env_schedule, format_repro
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FRAMES_DIR = os.path.join(REPO, "tests", "golden", "frames")
+TRACES_DIR = os.path.join(REPO, "tests", "golden", "traces")
+
+
+def _load_trace(fname):
+    with open(os.path.join(TRACES_DIR, fname)) as f:
+        return json.load(f)
+
+
+# ---------------------------------------------------------------------------
+# Wire conformance
+# ---------------------------------------------------------------------------
+
+def test_golden_frames_cover_every_frame_type():
+    got = {t for t, _name, _b in wire.golden_frames()}
+    assert got == set(wire.FRAME_NAMES), "a FrameType has no golden vector"
+
+
+@pytest.mark.parametrize("ftype,name,framed",
+                         wire.golden_frames(),
+                         ids=[n for _t, n, _b in wire.golden_frames()])
+def test_golden_fixture_pins_python_mirror(ftype, name, framed):
+    path = os.path.join(FRAMES_DIR, f"{ftype:02d}_{name}.bin")
+    with open(path, "rb") as f:
+        fixture = f.read()
+    assert fixture == framed, (
+        f"{name}: wire.py no longer reproduces the checked-in golden "
+        f"bytes — the Python mirror drifted from the frozen grammar")
+
+
+@pytest.mark.parametrize("ftype,name,framed",
+                         wire.golden_frames(),
+                         ids=[n for _t, n, _b in wire.golden_frames()])
+def test_golden_fixture_pins_native_encoder(ftype, name, framed):
+    from horovod_tpu.core import engine
+    native = engine.frame_golden(ftype)
+    assert native == framed, (
+        f"{name}: c_api.cc hvd_frame_golden disagrees with wire.py — "
+        f"message.cc and the Python mirror drifted apart")
+
+
+def test_frame_roundtrip_through_parse_and_payload_codecs():
+    for ftype, name, framed in wire.golden_frames():
+        header, payload = wire.parse_frame(framed)
+        assert header.type == ftype
+        codec = wire.PAYLOAD_CODECS.get(ftype)
+        if codec is None:  # HELLO_ACK / HEARTBEAT: empty payloads
+            assert payload == b""
+            continue
+        decoded = codec.decode(payload)
+        assert decoded.encode() == payload, f"{name} re-encode drifted"
+
+
+def test_parse_frame_rejects_corruption():
+    _t, _n, framed = wire.golden_frames()[2]  # REQUEST
+    flipped = bytearray(framed)
+    flipped[-1] ^= 0xFF
+    with pytest.raises(wire.WireError, match="CRC"):
+        wire.parse_frame(bytes(flipped))
+    with pytest.raises(wire.WireError, match="magic"):
+        wire.parse_frame(b"\x00" * len(framed))
+    with pytest.raises(wire.WireError, match="length mismatch"):
+        wire.parse_frame(framed[:-1])
+
+
+def test_bulk_token_matches_native():
+    from horovod_tpu.core import engine
+    lib = engine.lib()
+    import ctypes
+    lib.hvd_bulk_token.restype = ctypes.c_uint64
+    lib.hvd_bulk_token.argtypes = [ctypes.c_longlong, ctypes.c_longlong,
+                                   ctypes.c_int, ctypes.c_int]
+    for args in ((99, 3, 1, 2), (0, 0, 0, 0), (1 << 40, 7, 5, 11)):
+        assert wire.bulk_token(*args) == lib.hvd_bulk_token(*args)
+
+
+# ---------------------------------------------------------------------------
+# PR-14 regression traces (tests/golden/traces/)
+# ---------------------------------------------------------------------------
+
+def test_trace_lost_completion_fails_on_prefix_model():
+    doc = _load_trace("serving_lost_completion.json")
+    buggy = ServingDrainModel(**doc["bug_flags"])
+    v = replay_trace(buggy, doc["trace"])
+    assert getattr(v, "invariant", None) == doc["invariant"], (
+        "the reverted model no longer fails this trace — the "
+        "counterexample lost its teeth")
+
+
+def test_trace_lost_completion_passes_on_current_model():
+    doc = _load_trace("serving_lost_completion.json")
+    final = replay_trace(ServingDrainModel(), doc["trace"])
+    assert not hasattr(final, "invariant"), f"fixed model violated: {final}"
+    assert all(w.lost == 0 for w in final.workers)
+
+
+def test_trace_drain_wedge_fails_on_prefix_model():
+    doc = _load_trace("serving_drain_wedge.json")
+    buggy = ServingDrainModel(**doc["bug_flags"])
+    v = replay_trace(buggy, doc["trace"])
+    assert getattr(v, "invariant", None) == doc["invariant"]
+
+
+def test_trace_drain_wedge_passes_on_current_model():
+    doc = _load_trace("serving_drain_wedge.json")
+    final = replay_trace(ServingDrainModel(), doc["trace"])
+    assert not hasattr(final, "invariant"), f"fixed model violated: {final}"
+
+
+def test_checker_rederives_lost_completion_from_scratch():
+    r = check_bfs(ServingDrainModel(deliver_before_tick=False))
+    assert r.violation is not None
+    assert r.violation.invariant == "no-lost-completion"
+    # BFS returns a SHORTEST counterexample; the checked-in trace is one.
+    doc = _load_trace("serving_lost_completion.json")
+    assert len(r.violation.trace) == len(doc["trace"])
+
+
+def test_checker_rederives_drain_wedge_from_scratch():
+    r = check_bfs(ServingDrainModel(drain_by_protocol=False))
+    assert r.violation is not None
+    assert r.violation.invariant == "quiescence"
+    doc = _load_trace("serving_drain_wedge.json")
+    assert len(r.violation.trace) == len(doc["trace"])
+
+
+def test_replay_rejects_inapplicable_trace():
+    with pytest.raises(ValueError, match="not enabled"):
+        replay_trace(ServingDrainModel(), [["detect", 0]])
+
+
+# ---------------------------------------------------------------------------
+# Spec sweeps — the fixed models, exhaustively
+# ---------------------------------------------------------------------------
+
+def test_serving_fixed_model_exhaustive():
+    r = check_bfs(ServingDrainModel())
+    assert r.ok, str(r.violation)
+    assert r.complete, "frontier not drained: raise max_depth"
+
+
+def test_serving_fixed_model_at_scale_10k_states():
+    # The acceptance bar: the shipped star+elastic+serving-drain
+    # composition holds every invariant over >= 10^4 distinct states.
+    r = check_bfs(ServingDrainModel(workers=3, reqs=2, crashes=1))
+    assert r.ok, str(r.violation)
+    assert r.complete
+    assert r.states >= 10_000, f"only {r.states} states: model degenerated?"
+
+
+def test_elastic_fixed_model_exhaustive():
+    r = check_bfs(ElasticModel())
+    assert r.ok, str(r.violation)
+    assert r.complete
+
+
+def test_tree_spec_model_exhaustive():
+    r = check_bfs(TreeModel(), max_depth=60)
+    assert r.ok, str(r.violation)
+    assert r.complete
+
+
+@pytest.mark.parametrize("flags,invariant", [
+    ({"promotion_bumps_epoch": False}, "single-coordinator"),
+    ({"clamp_join_id": False}, "quiescence"),
+    ({"idempotent_reissue": False}, "ticket-single-use"),
+])
+def test_elastic_bug_knobs_produce_named_violations(flags, invariant):
+    r = check_bfs(ElasticModel(**flags))
+    assert r.violation is not None, f"{flags}: no counterexample found"
+    assert r.violation.invariant == invariant, str(r.violation)
+
+
+@pytest.mark.parametrize("flag", [
+    "replicate_before_fanout",
+    "root_replicate_before_send",
+    "root_replays_stale",
+])
+def test_tree_ordering_rules_are_load_bearing(flag):
+    # The item-3 spec: flip any replication-ordering rule off and some
+    # interleaving wedges a member forever.
+    r = check_bfs(TreeModel(**{flag: False}), max_depth=60)
+    assert r.violation is not None, f"{flag}=False: no counterexample"
+    assert r.violation.invariant == "quiescence", str(r.violation)
+
+
+def test_walk_is_deterministic_for_a_seed():
+    a = check_walk(ServingDrainModel(), seed=7, steps=60, walks=20)
+    b = check_walk(ServingDrainModel(), seed=7, steps=60, walks=20)
+    assert (a.states, a.transitions, a.depth) == \
+        (b.states, b.transitions, b.depth)
+    c = check_walk(ServingDrainModel(), seed=8, steps=60, walks=20)
+    assert (a.states, a.transitions) != (c.states, c.transitions)
+
+
+# ---------------------------------------------------------------------------
+# Model -> wire conformance: traces only speak frames message.cc accepts
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("model,trace", [
+    (ServingDrainModel(),
+     [["step", 0], ["step", 1], ["deliver_req", 0], ["deliver_req", 1],
+      ["quit", 0], ["crash", 1], ["detect", 1], ["deliver_resp", 0]]),
+    (ElasticModel(),
+     [["progress", ], ["replicate", ], ["deliver_state", ], ["knock", ],
+      ["poll_join", ], ["deliver_ack", ], ["fail_coord", "partition"],
+      ["promote", ], ["deliver_reconfig", ]]),
+    (TreeModel(),
+     [["announce", 0, 0], ["announce", 0, 1], ["agg_up", 0],
+      ["announce", 1, 0], ["announce", 1, 1], ["agg_up", 1],
+      ["root_decide"], ["root_replicate"], ["root_send", 0],
+      ["relay_replicate", 0], ["relay_fanout", 0, 0]]),
+], ids=["serving", "elastic", "tree"])
+def test_model_frames_encode_through_real_grammar(model, trace):
+    frames = frames_in_trace(model, trace)
+    assert frames, "trace sent nothing: conformance hook is dead"
+    seen = set()
+    for name, payload_struct, epoch in frames:
+        ftype = wire.FRAME_TYPES[name]
+        framed = wire.frame(ftype, payload_struct.encode(), epoch)
+        header, payload = wire.parse_frame(framed)
+        assert header.type == ftype
+        assert header.flags == epoch & 0xFFFF
+        codec = wire.PAYLOAD_CODECS[ftype]
+        assert codec.decode(payload).encode() == payload
+        seen.add(name)
+    assert len(seen) >= 3, f"trace only exercised {seen}"
+
+
+# ---------------------------------------------------------------------------
+# Counterexample -> fault-schedule translation (replay.py)
+# ---------------------------------------------------------------------------
+
+def test_env_schedule_crash_roundtrips_through_faults_parser(monkeypatch):
+    from horovod_tpu import faults
+    doc = _load_trace("serving_lost_completion.json")
+    env = env_schedule(ServingDrainModel(**doc["bug_flags"]), doc["trace"])
+    assert env == {"HVD_TPU_FAULT_KILL_RANK": "1",
+                   "HVD_TPU_FAULT_KILL_STEP": "0"}
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    plan = faults._plan_from_env()
+    assert (plan.kill_rank, plan.kill_step) == (1, 0)
+
+
+def test_env_schedule_partition_emits_wire_grammar(monkeypatch):
+    from horovod_tpu import faults
+    model = ElasticModel(promotion_bumps_epoch=False)
+    r = check_bfs(model)
+    env = env_schedule(model, r.violation.trace)
+    assert "HVD_TPU_FAULT_WIRE_PARTITION" in env
+    monkeypatch.setenv("HVD_TPU_FAULT_WIRE_PARTITION",
+                       env["HVD_TPU_FAULT_WIRE_PARTITION"])
+    plan = faults._plan_from_env()
+    rank, frame, epoch = plan.wire_partition
+    assert rank == 0 and frame >= 0 and epoch >= 0
+
+
+def test_env_schedule_wedge_needs_no_injector():
+    # The negative-id JOIN park wedges with a healthy coordinator: no
+    # fault event in the trace, so no injector in the schedule.
+    model = ElasticModel(clamp_join_id=False)
+    r = check_bfs(model)
+    assert env_schedule(model, r.violation.trace) == {}
+    repro = format_repro(model, r.violation.trace, r.violation)
+    assert "no injector needed" in repro
+    assert "quiescence" in repro
+
+
+def test_format_repro_exports_are_pastable():
+    doc = _load_trace("serving_lost_completion.json")
+    model = ServingDrainModel(**doc["bug_flags"])
+    v = replay_trace(model, doc["trace"])
+    repro = format_repro(model, doc["trace"], v)
+    assert "export HVD_TPU_FAULT_KILL_RANK=1" in repro
+    assert "no-lost-completion" in repro
+
+
+# ---------------------------------------------------------------------------
+# The CI entry point
+# ---------------------------------------------------------------------------
+
+def test_modelcheck_cli_green_and_skippable():
+    import subprocess
+    import sys
+    env = {**os.environ, "PYTHONPATH": REPO, "MODELCHECK_DEPTH": "60"}
+    run = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.analysis.protocol"],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert run.returncode == 0, run.stdout + run.stderr
+    assert "all invariants hold" in run.stdout
+    skipped = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.analysis.protocol"],
+        capture_output=True, text=True,
+        env={**env, "MODELCHECK_SKIP": "1"}, timeout=60)
+    assert skipped.returncode == 0
+    assert "skipped" in skipped.stdout
