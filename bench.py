@@ -120,14 +120,23 @@ Two phases, one JSON metric line each:
         "qps": Q, "ttft_p50_ms": ..., "ttft_p99_ms": ...,
         "token_p50_ms": ..., "token_p99_ms": ...}          (x3 QPS levels)
        {"metric": "serving_tick_cache_hits", ...}   (zero NEGOTIATED)
+       {"metric": "serving_prefix_ttft", "cache": "on|off",
+        "shared_frac": F, "prefix_hit_rate": ..., "ttft_p50_ms": ...}
+                                                    (x2 sharing fractions)
+       {"metric": "serving_spec_decode_uplift", "value": U, "unit": "x",
+        "spec_accept_rate": ...}
+       {"metric": "serving_router_slo", "model": ..., "slo_attainment": ...}
+                                                    (x2 models)
        {"metric": "serving_autoscale_soak", ...}    (lost=0, disk_reads=0)
 
    Asserted, not just reported: continuous batching >= 2x the static
    drain barrier's tokens/s at saturation; every steady-state
-   ``serving.tick`` is a response-cache hit; the soak's joiner clones
-   weights over the data plane with zero disk reads and a SIGKILLed
-   replica loses zero accepted requests.  ``BENCH_SERVE_DURATION_S``
-   resizes the sweep.
+   ``serving.tick`` is a response-cache hit; the prefix cache strictly
+   lowers TTFT p50 at high prompt sharing; speculative decoding lifts
+   tokens/s >= 1.3x on a repetitive-suffix workload; the soak's joiner
+   clones weights over the data plane with zero disk reads and a
+   SIGKILLed replica (with prefix cache + speculation ON) loses zero
+   accepted requests.  ``BENCH_SERVE_DURATION_S`` resizes the sweep.
 
 ``BENCH_SKIP_EAGER=1`` / ``BENCH_SKIP_RESNET=1`` / ``BENCH_SKIP_PLAN=1``
 / ``BENCH_SKIP_CKPT=1`` / ``BENCH_SKIP_DATAPLANE=1`` /
@@ -820,8 +829,12 @@ def serving_bench() -> None:
     The model is a small real Transformer on the KV-cache decode path
     (CPU jax): the numbers are not TPU headline figures, but every ratio
     asserted here — continuous >= 2x static at saturation, zero
-    steady-state negotiations, zero disk reads on the clone path, zero
-    lost requests through a SIGKILL — is shape-level and carries."""
+    steady-state negotiations, prefix cache strictly lowering TTFT at
+    high sharing, speculation >= 1.3x tokens/s on a predictable stream,
+    zero disk reads on the clone path, zero lost requests through a
+    SIGKILL — is shape-level and carries.  The prefix/spec/router legs
+    use the stub backend (synthetic per-token prefill and per-step decode
+    cost) so the ratios measure scheduling, not XLA dispatch jitter."""
     import jax
     import jax.numpy as jnp
 
@@ -830,7 +843,8 @@ def serving_bench() -> None:
     from horovod_tpu.models.transformer import Transformer, TransformerConfig
     from horovod_tpu.serving import loadgen, soak
     from horovod_tpu.serving.engine import (ServingConfig, ServingEngine,
-                                            TransformerBackend)
+                                            StubBackend, TransformerBackend)
+    from horovod_tpu.serving.router import ModelSpec, Router
 
     cfg = ServingConfig(num_slots=8, buckets=(16, 32, 64), max_seq_len=128)
     mcfg = TransformerConfig(vocab_size=256, num_layers=2, num_heads=2,
@@ -970,10 +984,134 @@ def serving_bench() -> None:
     finally:
         coll.shutdown()
 
+    # Prefix cache: shared-system-prompt traffic at two sharing
+    # fractions, cache ON vs OFF.  The stub backend charges synthetic
+    # prefill compute per prefilled token, so the TTFT saving measures
+    # exactly what the cache removes: re-prefilling the shared prefix.
+    # The completion streams are identical either way (the stub's first
+    # token is a function of the FULL prompt) — only latency moves.
+    import random as _random
+
+    prefix_rows = {}
+    for frac in (0.5, 0.9):
+        for cache_on in (False, True):
+            scfg = ServingConfig(num_slots=8, buckets=(16, 32, 64, 96),
+                                 max_seq_len=128,
+                                 prefix_cache_pages=32 if cache_on else 0,
+                                 page_size=8)
+            seng = ServingEngine(
+                StubBackend(scfg.num_slots, 256, step_s=0.0002,
+                            prefill_s_per_token=0.0008), scfg)
+            wq = loadgen.Workload(qps=30.0, duration_s=dur, seed=5,
+                                  prompt_lens=(6, 14, 30), short_new=4,
+                                  long_new=16, long_frac=0.1, vocab=256,
+                                  shared_frac=frac, shared_prefix_len=48)
+            rep = loadgen.run_load(seng, wq, max_wall_s=dur * 30)
+            st = seng.stats()
+            prefix_rows[(frac, cache_on)] = rep
+            print(json.dumps({
+                "metric": "serving_prefix_ttft",
+                "value": round(rep["ttft_p50_ms"], 2),
+                "unit": "ms",
+                "cache": "on" if cache_on else "off",
+                "shared_frac": frac,
+                "prefix_hit_rate": round(st["prefix_hit_rate"], 3),
+                "prefix_evictions": st["prefix_evictions"],
+                "ttft_p99_ms": round(rep["ttft_p99_ms"], 2),
+                "tokens_per_s": round(rep["tokens_per_s"], 1),
+                "completed": rep["completed"],
+            }))
+            if cache_on:
+                assert st["prefix_hit_rate"] > 0.2, (
+                    f"shared_frac={frac}: prefix cache barely hit "
+                    f"({st['prefix_hit_rate']:.3f})")
+    on_p50 = prefix_rows[(0.9, True)]["ttft_p50_ms"]
+    off_p50 = prefix_rows[(0.9, False)]["ttft_p50_ms"]
+    assert on_p50 < off_p50, (
+        f"prefix cache must strictly lower TTFT p50 at 90% sharing: "
+        f"on={on_p50:.2f}ms off={off_p50:.2f}ms")
+
+    # Speculative decoding: a periodic token stream the n-gram proposer
+    # can actually predict.  Closed-loop (submit all, drain) so tokens/s
+    # isolates decode-step count; the stub charges step_s per decode AND
+    # per verify step, so the uplift comes only from accepted drafts
+    # collapsing steps — the honest accounting.
+    def spec_run(k: int):
+        scfg = ServingConfig(num_slots=8, buckets=(16, 32),
+                             max_seq_len=128, spec_k=k)
+        seng = ServingEngine(StubBackend(scfg.num_slots, 256, step_s=0.002,
+                                         period=8), scfg)
+        rng = _random.Random(7)
+        for _ in range(16):
+            plen = rng.choice((6, 10))
+            seng.submit([rng.randrange(8) for _ in range(plen)], 48)
+        t0 = time.perf_counter()
+        done = seng.run_until_idle()
+        wall = time.perf_counter() - t0
+        toks = sum(len(r.tokens) for r in done)
+        return toks / max(wall, 1e-9), seng.stats()
+
+    plain_tps, _ = spec_run(0)
+    spec_tps, spec_st = spec_run(4)
+    uplift = spec_tps / max(plain_tps, 1e-9)
+    assert uplift >= 1.3, (
+        f"speculation must lift tokens/s >= 1.3x on the repetitive "
+        f"stream: plain={plain_tps:.1f} spec={spec_tps:.1f} tok/s")
+    assert spec_st["spec_accept_rate"] > 0.3, spec_st
+    print(json.dumps({
+        "metric": "serving_spec_decode_uplift",
+        "value": round(uplift, 2),
+        "unit": "x",
+        "plain_tokens_per_s": round(plain_tps, 1),
+        "spec_tokens_per_s": round(spec_tps, 1),
+        "spec_k": 4,
+        "spec_accept_rate": round(spec_st["spec_accept_rate"], 3),
+        "spec_drafted": spec_st["spec_drafted"],
+        "spec_accepted": spec_st["spec_accepted"],
+    }))
+
+    # Multi-model router: a fast chat model (2 replicas, tight SLO) and a
+    # slow code model (1 replica, loose SLO) behind one admission door;
+    # per-model TTFT SLO attainment is the row the router exists to move.
+    router = Router()
+
+    def stub_engine(step_s: float) -> ServingEngine:
+        rcfg = ServingConfig(num_slots=4, buckets=(16, 32), max_seq_len=64)
+        return ServingEngine(StubBackend(rcfg.num_slots, 256,
+                                         step_s=step_s), rcfg)
+
+    router.add_model(ModelSpec("chat", slo_ttft_ms=40.0),
+                     [stub_engine(0.0005), stub_engine(0.0005)])
+    router.add_model(ModelSpec("code", slo_ttft_ms=200.0),
+                     [stub_engine(0.004)])
+    rrng = _random.Random(11)
+    submitted = {"chat": 0, "code": 0}
+    for i in range(40):
+        name = "chat" if i % 2 == 0 else "code"
+        plen = rrng.choice((6, 12))
+        router.submit(name, [rrng.randrange(256) for _ in range(plen)], 8)
+        submitted[name] += 1
+    router.run_until_idle()
+    for name, st in router.stats().items():
+        assert st["completed"] == submitted[name], (name, st)
+        print(json.dumps({
+            "metric": "serving_router_slo",
+            "value": round(st["slo_attainment"], 3),
+            "unit": "frac",
+            "model": name,
+            "replicas": st["replicas"],
+            "slo_ttft_ms": st["slo_ttft_ms"],
+            "ttft_p50_ms": round(st["ttft_p50_ms"], 2),
+            "ttft_p99_ms": round(st["ttft_p99_ms"], 2),
+            "completed": st["completed"],
+        }))
+
     # Autoscale chaos soak: grow under load (weights cloned over the bulk
-    # data plane, zero disk reads) + SIGKILL mid-traffic (zero lost).
+    # data plane, zero disk reads) + SIGKILL mid-traffic (zero lost) —
+    # with the prefix cache and speculation ON, the fast paths must not
+    # cost a single completion either.
     r = soak.run_fleet(n=2, qps=30.0, duration_s=3.0, kill=True, join=True,
-                       swap=False, seed=0)
+                       swap=False, seed=0, prefix_cache=True, spec_k=3)
     assert r["lost"] == 0 and r["join_disk_reads"] == 0, r
     print(json.dumps({
         "metric": "serving_autoscale_soak",

@@ -55,6 +55,9 @@ def _teeth():
            "no-lost-completion")
     yield ("serving drain_by_protocol=False    [PR-14 bug 2]",
            ServingDrainModel(drain_by_protocol=False), "quiescence")
+    yield ("serving refcount_shared_pages=False [prefix-cache bug]",
+           ServingDrainModel(reqs=2, refcount_shared_pages=False),
+           "page-refcount")
     yield ("elastic promotion_bumps_epoch=False",
            ElasticModel(promotion_bumps_epoch=False), "single-coordinator")
     yield ("elastic clamp_join_id=False        [PR-14 sentinel]",

@@ -22,9 +22,21 @@ can re-derive both shipped bugs as counterexamples:
   QUIT drain wedge).  The fix keeps ticking with done_flag raised and
   leaves only when the fleet-wide ``serving.drained`` one-shot completes.
 
-Both True models the code as shipped today; the bounded exhaustive run
-over that configuration passing all invariants is the `make modelcheck`
-CI gate.
+A third flag covers the prefix-cache refcount protocol
+(serving/prefix_cache.py): every pending request on a replica holds a
+reference on that replica's shared prefix KV page, and the page may be
+freed only when the last reference drops.
+
+* ``refcount_shared_pages=False`` — the page is freed on the FIRST slot
+  release regardless of the other live references (and torn down on a
+  RECONFIG while slots still point at it) -> "page-refcount" violation:
+  a surviving slot now decodes through a recycled page.  The fix
+  (PrefixCache.release) decrements and frees only at refs == 0; refcounts
+  survive RECONFIG because the engine re-admits slots before releasing.
+
+All flags True models the code as shipped today; the bounded exhaustive
+run over that configuration passing all invariants is the `make
+modelcheck` CI gate.
 """
 
 from __future__ import annotations
@@ -32,8 +44,8 @@ from __future__ import annotations
 from typing import NamedTuple
 
 from horovod_tpu.analysis.protocol import wire
-from horovod_tpu.analysis.protocol.invariants import (epoch_not_ahead,
-                                                      no_lost_completion)
+from horovod_tpu.analysis.protocol.invariants import (
+    epoch_not_ahead, no_lost_completion, shared_page_refcounted)
 
 
 class WState(NamedTuple):
@@ -47,6 +59,8 @@ class WState(NamedTuple):
     lost: int            # completions destroyed with a replaced engine
     quitting: bool
     drain_enqueued: bool  # the one-shot serving.drained is pending
+    page_refs: int       # live slot references on the shared prefix page
+    page_live: bool      # the shared KV page is still allocated
 
 
 class FleetState(NamedTuple):
@@ -77,19 +91,25 @@ class ServingDrainModel:
 
     def __init__(self, workers: int = 2, reqs: int = 1, crashes: int = 1,
                  deliver_before_tick: bool = True,
-                 drain_by_protocol: bool = True) -> None:
+                 drain_by_protocol: bool = True,
+                 refcount_shared_pages: bool = True) -> None:
         self.n = workers
         self.reqs = reqs
         self.crashes = crashes
         self.deliver_before_tick = deliver_before_tick
         self.drain_by_protocol = drain_by_protocol
+        self.refcount_shared_pages = refcount_shared_pages
         self.invariants = [
             ("no-lost-completion", no_lost_completion),
             ("epoch-monotonic", epoch_not_ahead),
+            ("page-refcount", shared_page_refcounted),
         ]
 
     def initial(self) -> FleetState:
-        w = WState("up", "run", 0, self.reqs, 0, 0, 0, False, False)
+        # Every accepted request holds a reference on the replica's shared
+        # prefix page (the PrefixCache admission contract).
+        w = WState("up", "run", 0, self.reqs, 0, 0, 0, False, False,
+                   self.reqs, True)
         return FleetState(epoch=0, members=tuple(range(self.n)),
                           announced=(), drain_announced=(),
                           crash_budget=self.crashes, detect_pending=(),
@@ -174,6 +194,17 @@ class ServingDrainModel:
         completed = 1 if w.pending > 0 else 0
         pending = w.pending - completed
         done_pending, delivered = w.done_pending, w.delivered
+        page_refs, page_live = w.page_refs, w.page_live
+        if completed:
+            page_refs -= completed
+            if self.refcount_shared_pages:
+                # Fixed order (PrefixCache.release): deref, free only when
+                # the LAST reference drops.
+                page_live = page_live and page_refs > 0
+            else:
+                # PRE-FIX: the first slot release frees the shared page
+                # outright, ignoring the other live references.
+                page_live = False
         if self.deliver_before_tick:
             # Fixed order (serving/engine.py): on_complete fires before the
             # tick collective, so nothing rides across MembershipChanged.
@@ -186,14 +217,16 @@ class ServingDrainModel:
             # Pre-fix worker.py: leave as soon as MY queue drains, peers
             # mid-tick be damned.
             w = w._replace(status="exited", pending=pending,
-                           done_pending=done_pending, delivered=delivered)
+                           done_pending=done_pending, delivered=delivered,
+                           page_refs=page_refs, page_live=page_live)
             return _tset_worker(s, i, w)
         drain_enq = w.drain_enqueued or (mine_done and self.drain_by_protocol)
         if frames is not None:
             frames.append(_tick_request(w.epoch, drain_enq))
         w = w._replace(phase="wait", pending=pending,
                        done_pending=done_pending, delivered=delivered,
-                       drain_enqueued=drain_enq)
+                       drain_enqueued=drain_enq,
+                       page_refs=page_refs, page_live=page_live)
         s = _tset_worker(s, i, w)
         return s._replace(
             up_links=_tset(s.up_links, i,
@@ -256,8 +289,16 @@ class ServingDrainModel:
             # with the aborted collective's engine.
             lost += done_pending
             done_pending = 0
+        page_live = w.page_live
+        if not self.refcount_shared_pages and w.page_refs > 0:
+            # PRE-FIX page bug, RECONFIG flavor: the replaced engine tears
+            # its KV pool down wholesale while re-admitted slots still
+            # point at the shared page.  The fix keeps refcounts across
+            # RECONFIG: slots survive, so their references do too.
+            page_live = False
         w = w._replace(phase="run", epoch=epoch, lost=lost,
-                       done_pending=done_pending, drain_enqueued=False)
+                       done_pending=done_pending, drain_enqueued=False,
+                       page_live=page_live)
         return _tset_worker(s, i, w)
 
     def _detect(self, s: FleetState, i: int, frames) -> FleetState:

@@ -21,6 +21,10 @@ Naming (docs/static_analysis.md "Protocol model checking"):
                              at most one joiner, and a joiner holds at
                              most one seat per epoch (retries must be
                              idempotent, not generative).
+* ``page-refcount``        — a shared prefix KV page is never freed while
+                             a live slot still references it (including
+                             across an elastic RECONFIG: slots survive
+                             the engine swap, so their references do too).
 * ``standby-not-ahead``    — replicated standby state never runs ahead of
                              its primary's authoritative state (else a
                              promotion could replay a future the primary
@@ -43,6 +47,17 @@ def no_lost_completion(s) -> str | None:
         if w.status == "exited" and w.done_pending > 0:
             return (f"replica {i} exited holding {w.done_pending} "
                     f"undelivered completion(s)")
+    return None
+
+
+def shared_page_refcounted(s) -> str | None:
+    """FleetState: no replica's shared prefix KV page is freed while any
+    live slot on that replica still references it — the PrefixCache
+    release contract (free only when the last reference drops)."""
+    for i, w in enumerate(s.workers):
+        if not w.page_live and w.page_refs > 0:
+            return (f"replica {i} freed its shared prefix KV page with "
+                    f"{w.page_refs} live slot reference(s) still attached")
     return None
 
 

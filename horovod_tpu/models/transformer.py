@@ -117,20 +117,37 @@ def init_kv_cache(cfg: TransformerConfig, num_slots: int,
     return jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype)
 
 
-def cached_decode_attention(q, k_cache, v_cache, lengths):
-    """One-position attention over a per-slot KV cache.
+def init_kv_pages(cfg: TransformerConfig, num_pages: int, page_size: int):
+    """Content-addressed KV page pool for the shared-prefix cache
+    (serving/prefix_cache.py): two ``[L, pages, page_size, H, D]`` arrays.
+    Unlike :func:`init_kv_cache`, positions are not owned by a slot — a
+    slot is a row of page ids (its page table) and a page holding a
+    shared prompt-prefix chunk can appear in many slots' rows at once.
+    Page 0 is the scratch page inactive slots point at."""
+    shape = (cfg.num_layers, num_pages, page_size, cfg.num_heads,
+             cfg.head_dim)
+    return jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype)
 
-    ``q``: [B, 1, H, D] (the position being decoded per slot),
-    ``k_cache``/``v_cache``: [B, S, H, D] with positions ``0..lengths[b]``
-    valid (``lengths[b]`` is the position just written), everything past
-    it masked.  Same f32-softmax/-1e30-mask arithmetic as
+
+def cached_decode_attention(q, k_cache, v_cache, lengths):
+    """Block attention over a per-slot KV cache.
+
+    ``q``: [B, S_q, H, D] — the block of positions being decoded per
+    slot: one position for plain decode, the speculative draft window
+    for batched verification, or a prompt suffix for prefix-attached
+    prefill.  ``k_cache``/``v_cache``: [B, S, H, D] with query row ``i``
+    sitting at position ``lengths[b] + i`` (``lengths[b]`` is the first
+    position of the block, just written), everything past each row's own
+    position masked causally.  Same f32-softmax/-1e30-mask arithmetic as
     :func:`dense_causal_attention`, so an incrementally decoded position
     matches the full forward pass."""
     scale = q.shape[-1] ** -0.5
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache).astype(
         jnp.float32) * scale
-    s = k_cache.shape[1]
-    mask = (jnp.arange(s)[None, :] <= lengths[:, None])[:, None, None, :]
+    s, s_q = k_cache.shape[1], q.shape[1]
+    qpos = lengths[:, None] + jnp.arange(s_q)[None, :]         # [B, S_q]
+    mask = (jnp.arange(s)[None, None, :]
+            <= qpos[:, :, None])[:, None, :, :]                # [B,1,S_q,S]
     logits = jnp.where(mask, logits, -1e30)
     probs = nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache)
@@ -260,7 +277,11 @@ class Transformer(nn.Module):
         x = nn.Embed(cfg.vocab_size, cfg.embed_dim, dtype=cfg.dtype,
                      param_dtype=cfg.param_dtype, name="embed")(tokens)
         if decode:
-            positions = jnp.asarray(lengths)[:, None]
+            # Block row i of a cache call decodes position lengths + i:
+            # S=1 is plain decode, S>1 is a speculative verify window or a
+            # prefix-attached prompt-suffix prefill.
+            positions = (jnp.asarray(lengths)[:, None]
+                         + jnp.arange(tokens.shape[1])[None, :])
         if positions is None and cfg.context_axis and \
                 cfg.context_plan is not None:
             from horovod_tpu.parallel.context import context_positions
@@ -300,8 +321,13 @@ class Transformer(nn.Module):
                           param_dtype=cfg.param_dtype, name="lm_head")(x)
         logits = logits.astype(cfg.logits_dtype)
         if decode:
-            return logits[:, 0], (jnp.stack([kv[0] for kv in kvs]),
-                                  jnp.stack([kv[1] for kv in kvs]))
+            kv_out = (jnp.stack([kv[0] for kv in kvs]),
+                      jnp.stack([kv[1] for kv in kvs]))
+            if tokens.shape[1] == 1:
+                return logits[:, 0], kv_out
+            # Multi-token cache call (speculative verify / suffix
+            # prefill): the caller needs every block position's logits.
+            return logits, kv_out
         if return_kv:
             return logits, (jnp.stack([kv[0] for kv in kvs]),
                             jnp.stack([kv[1] for kv in kvs]))

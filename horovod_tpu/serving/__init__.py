@@ -10,14 +10,19 @@ to joiners over the PR-11 bulk data plane (zero disk reads).
 
 Layout:
 
-* ``engine.py``    — ``ServingEngine`` scheduler, backends, and
-  ``hvd.serving_stats()``.
-* ``autoscale.py`` — queue-depth/p99-driven replica-count policy and the
-  data-plane weight clone / hot-swap helpers.
-* ``loadgen.py``   — open-loop Poisson load generator and latency report.
-* ``worker.py``    — one serving replica speaking a line protocol
+* ``engine.py``       — ``ServingEngine`` scheduler, backends (dense,
+  paged, stub), speculative decoding, and ``hvd.serving_stats()``.
+* ``prefix_cache.py`` — content-addressed, refcounted KV page cache
+  (radix trie over token chunks) behind the engine's admission path.
+* ``router.py``       — multi-model admission front door and the
+  cross-model replica-budget arbitration (``RouterAutoscaler``).
+* ``autoscale.py``    — queue-depth/p99-driven replica-count policy and
+  the data-plane weight clone / hot-swap helpers.
+* ``loadgen.py``      — open-loop Poisson load generator (with a
+  shared-prefix workload mode) and latency report.
+* ``worker.py``       — one serving replica speaking a line protocol
   (used by the soak fleet and ``run.py --serve``).
-* ``soak.py``      — multi-process autoscale/replica-kill soak driver.
+* ``soak.py``         — multi-process autoscale/replica-kill soak driver.
 
 Module-level imports stay jax-free so engine-only fleets (soak workers,
 bench subprocesses) boot without paying the jax import.
@@ -25,9 +30,14 @@ bench subprocesses) boot without paying the jax import.
 
 from __future__ import annotations
 
-from horovod_tpu.serving.engine import (Request, ServingConfig,
-                                        ServingEngine, StubBackend,
-                                        TransformerBackend, serving_stats)
+from horovod_tpu.serving.engine import (PagedTransformerBackend, Request,
+                                        ServingConfig, ServingEngine,
+                                        StubBackend, TransformerBackend,
+                                        serving_stats)
+from horovod_tpu.serving.prefix_cache import PrefixCache
+from horovod_tpu.serving.router import ModelSpec, Router, RouterAutoscaler
 
-__all__ = ["Request", "ServingConfig", "ServingEngine", "StubBackend",
-           "TransformerBackend", "serving_stats"]
+__all__ = ["ModelSpec", "PagedTransformerBackend", "PrefixCache",
+           "Request", "Router", "RouterAutoscaler", "ServingConfig",
+           "ServingEngine", "StubBackend", "TransformerBackend",
+           "serving_stats"]

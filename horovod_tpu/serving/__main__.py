@@ -11,7 +11,10 @@ Knobs (utils/env.py table): ``HVD_TPU_SERVE_BACKEND`` (``transformer`` —
 a small real model on the KV-cache decode path — or ``stub``, the
 jax-free token automaton), ``HVD_TPU_SERVE_QPS``,
 ``HVD_TPU_SERVE_DURATION_S``, plus the scheduler shape knobs
-``HVD_TPU_SERVE_SLOTS`` / ``_BUCKETS`` / ``_MAX_LEN``.
+``HVD_TPU_SERVE_SLOTS`` / ``_BUCKETS`` / ``_MAX_LEN`` and the fast-path
+knobs ``HVD_TPU_SERVE_PREFIX_PAGES`` / ``_PAGE_TOKENS`` (the
+transformer backend switches to the paged KV pool when the prefix
+cache is on) / ``_SPEC_K``.
 """
 
 from __future__ import annotations
@@ -44,6 +47,13 @@ def _make_backend(cfg: ServingConfig):
     model = Transformer(mcfg)
     toks = jax.numpy.zeros((1, cfg.buckets[0]), jax.numpy.int32)
     params = model.init(jax.random.PRNGKey(0), toks)
+    if cfg.prefix_cache_pages > 0:
+        from horovod_tpu.serving.engine import PagedTransformerBackend
+
+        return PagedTransformerBackend(
+            model, params, mcfg, cfg.num_slots, cfg.max_seq_len,
+            cache_pages=cfg.prefix_cache_pages,
+            page_size=cfg.page_size), params
     return TransformerBackend(model, params, mcfg, cfg.num_slots,
                               cfg.max_seq_len), params
 

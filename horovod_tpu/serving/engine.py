@@ -11,12 +11,23 @@ plane's response cache stays warm (steady-state decode ticks are all
 CACHE_HIT — asserted in tests/test_serving.py from ``cache_stats()``).
 
 The engine is backend-agnostic: ``TransformerBackend`` runs the real
-model on the KV-cache path of models/transformer.py; ``StubBackend`` is
-a numpy token automaton for engine-only fleets (soak workers, bench
+model on the KV-cache path of models/transformer.py;
+``PagedTransformerBackend`` swaps the dense per-slot cache for
+content-addressed KV pages read through per-slot page tables, which is
+what lets admissions attach to shared prompt-prefix pages
+(serving/prefix_cache.py) and prefill only their suffix; ``StubBackend``
+is a numpy token automaton for engine-only fleets (soak workers, bench
 subprocesses) that must not pay the jax import.  Every backend op is
 batch-row-independent, which is what makes continuous batching *safe*:
 a sequence's logits in a mixed batch are bit-identical to the same
 sequence decoded alone through the same-shaped program.
+
+Two optional fast paths compose on top, both preserving the one-program
+discipline and the emitted token stream bit-for-bit: shared-prefix KV
+reuse (``ServingConfig.prefix_cache_pages`` / any paged backend) and
+greedy speculative decoding (``ServingConfig.spec_k`` drafts per step
+from an n-gram prompt-lookup proposer, verified in one fixed-shape
+batched step — see ``_spec_step`` for the acceptance rule).
 
 The fleet-level protocol around this engine (completion delivery across
 RECONFIG, protocol-driven drain on QUIT) is model-checked by
@@ -36,13 +47,22 @@ from typing import Any, Callable
 
 import numpy as np
 
+from horovod_tpu.serving.prefix_cache import PrefixCache
+
 _ACTIVE = None  # most recently constructed ServingEngine, for serving_stats()
 
 _STATS_KEYS = (
     "active_slots", "queue_depth", "admitted", "evicted", "completed",
     "rejected", "retried", "steps", "tokens", "ttft_p50_ms", "ttft_p99_ms",
     "token_p50_ms", "token_p99_ms", "kv_slot_occupancy",
+    "prefix_hits", "prefix_hit_tokens", "prefix_evictions",
+    "prefix_hit_rate", "spec_drafted", "spec_accepted", "spec_accept_rate",
 )
+
+_FLOAT_STATS = frozenset((
+    "ttft_p50_ms", "ttft_p99_ms", "token_p50_ms", "token_p99_ms",
+    "kv_slot_occupancy", "prefix_hit_rate", "spec_accept_rate",
+))
 
 
 def _pctile(xs, q: float) -> float:
@@ -73,6 +93,9 @@ class Request:
     tokens: list[int] = dataclasses.field(default_factory=list)
     logits: list[Any] = dataclasses.field(default_factory=list)
     finish_reason: str | None = None
+    # Human-readable rejection cause, naming the violated limit and the
+    # env knob that raises it — populated only for "rejected" requests.
+    error: str | None = None
     ttft_s: float | None = None
     token_lat_s: list[float] = dataclasses.field(default_factory=list)
     _last_token_t: float = 0.0
@@ -95,13 +118,31 @@ class ServingConfig:
     static_batching: bool = False
     # Keep per-step logits on each request (tests only — unbounded).
     record_logits: bool = False
+    # Shared-prefix KV reuse (serving/prefix_cache.py): pages of cache
+    # slack beyond the slots' own working set that evicted requests'
+    # prefix chunks may keep resident.  0 disables the prefix cache for
+    # non-paged backends (a PagedTransformerBackend brings its own pool
+    # and always runs with the cache on).
+    prefix_cache_pages: int = 0
+    # Tokens per KV page — the unit of prefix sharing; max_seq_len must
+    # be a multiple of it when the prefix cache is enabled.
+    page_size: int = 16
+    # Speculative decoding draft window: propose k tokens per slot per
+    # step (n-gram prompt lookup, no draft model) and verify them in one
+    # fixed-shape batched step.  0 disables speculation.
+    spec_k: int = 0
+    # n-gram order the proposer matches on before falling back to 1.
+    spec_ngram: int = 2
 
     @staticmethod
     def from_env(**overrides) -> "ServingConfig":
         from horovod_tpu.utils import env
 
         base = dict(num_slots=env.serve_slots(), buckets=env.serve_buckets(),
-                    max_seq_len=env.serve_max_len())
+                    max_seq_len=env.serve_max_len(),
+                    prefix_cache_pages=env.serve_prefix_pages(),
+                    page_size=env.serve_page_tokens(),
+                    spec_k=env.serve_spec_k())
         base.update(overrides)
         return ServingConfig(**base)
 
@@ -114,20 +155,55 @@ class StubBackend:
     completion; the soak driver (serving/soak.py) relies on this to check
     no accepted request is lost or corrupted.  ``step_s`` adds synthetic
     per-step compute so requests stay in flight long enough to be killed
-    mid-decode."""
+    mid-decode; ``prefill_s_per_token`` adds synthetic prefill compute
+    proportional to the prefilled length, which is what makes the prefix
+    cache's TTFT saving measurable on the stub (a prefix-attached
+    admission sleeps only for its suffix).
+
+    ``period`` switches the automaton from the positional recurrence to
+    ``next = (prev + 1) % period`` — a repetitive stream whose future the
+    n-gram proposer can actually predict, for exercising the speculative
+    *accept* path (the positional stub's tokens depend on absolute
+    position, so lookahead drafts never match and speculation degrades to
+    plain decode — the reject path)."""
 
     def __init__(self, num_slots: int, vocab_size: int = 256,
-                 step_s: float = 0.0):
+                 step_s: float = 0.0, period: int | None = None,
+                 prefill_s_per_token: float = 0.0):
         self.num_slots = num_slots
         self.vocab_size = vocab_size
         self.step_s = step_s
+        self.period = period
+        self.prefill_s_per_token = prefill_s_per_token
 
     @staticmethod
     def _next(prev: int, pos: int, vocab: int) -> int:
         return (prev * 31 + pos * 7 + 1) % vocab
 
+    def _next_tok(self, prev: int, pos: int) -> int:
+        if self.period is not None:
+            return (int(prev) + 1) % self.period
+        return self._next(int(prev), int(pos), self.vocab_size)
+
     def prefill(self, padded: np.ndarray, length: int, slot: int):
+        if self.prefill_s_per_token:
+            time.sleep(self.prefill_s_per_token * length)
         first = (int(np.sum(padded[0, :length])) + length) % self.vocab_size
+        logits = np.zeros(self.vocab_size, np.float32)
+        logits[first] = 1.0
+        return first, logits
+
+    def prefill_prefixed(self, padded: np.ndarray, suffix_len: int,
+                         slot: int, prefix_len: int, prompt=None):
+        """Prefix-attached prefill: the cached prefix costs nothing, only
+        the suffix pays compute.  The first token is still a function of
+        the FULL prompt (the engine passes it), so completions are
+        bit-identical with the cache on or off."""
+        if self.prefill_s_per_token:
+            time.sleep(self.prefill_s_per_token * suffix_len)
+        full = list(prompt) if prompt is not None else \
+            list(padded[0, :suffix_len])
+        first = (int(sum(int(t) for t in full)) + len(full)) % self.vocab_size
         logits = np.zeros(self.vocab_size, np.float32)
         logits[first] = 1.0
         return first, logits
@@ -135,11 +211,29 @@ class StubBackend:
     def decode(self, last_tokens: np.ndarray, lengths: np.ndarray):
         if self.step_s:
             time.sleep(self.step_s)
-        nxt = np.array([self._next(int(t), int(p), self.vocab_size)
+        nxt = np.array([self._next_tok(int(t), int(p))
                         for t, p in zip(last_tokens, lengths)], np.int32)
         logits = np.zeros((self.num_slots, self.vocab_size), np.float32)
         logits[np.arange(self.num_slots), nxt] = 1.0
         return nxt, logits
+
+    def verify(self, tok_block: np.ndarray, lengths: np.ndarray):
+        """Batched draft verification: one decode-priced step scoring the
+        whole ``[B, k+1]`` block.  ``preds[b, j]`` is the token the plain
+        automaton would emit after consuming column ``j`` at position
+        ``lengths[b] + j`` — so column 0 reproduces :meth:`decode`
+        exactly, which is what makes greedy speculation lossless."""
+        if self.step_s:
+            time.sleep(self.step_s)
+        b_n, k1 = tok_block.shape
+        preds = np.zeros((b_n, k1), np.int32)
+        for b in range(b_n):
+            for j in range(k1):
+                preds[b, j] = self._next_tok(int(tok_block[b, j]),
+                                             int(lengths[b]) + j)
+        logits = np.zeros((b_n, k1, self.vocab_size), np.float32)
+        np.put_along_axis(logits, preds[:, :, None], 1.0, axis=2)
+        return preds, logits
 
 
 class TransformerBackend:
@@ -167,6 +261,7 @@ class TransformerBackend:
         self.kk, self.vv = init_kv_cache(model_cfg, num_slots, max_seq_len)
         self._prefill = jax.jit(self._prefill_fn, donate_argnums=(1, 2))
         self._decode = jax.jit(self._decode_fn, donate_argnums=(1, 2))
+        self._verify = jax.jit(self._verify_fn, donate_argnums=(1, 2))
 
     def _prefill_fn(self, params, kk, vv, padded, length, slot):
         jax, jnp = self._jax, self._jax.numpy
@@ -189,6 +284,20 @@ class TransformerBackend:
             lengths=jnp.maximum(lengths - 1, 0))
         return kk, vv, jnp.argmax(logits, axis=-1).astype(jnp.int32), logits
 
+    def _verify_fn(self, params, kk, vv, tok_block, lengths):
+        jnp = self._jax.numpy
+        # One cache call over the [B, k+1] block: row j's logits depend
+        # only on the cache plus block rows <= j (causal mask), so as
+        # long as rows 0..j carry the tokens greedy decode would have
+        # produced, preds[:, j] is bit-identical to plain decode's
+        # output at that position.  K/V for rejected rows land in the
+        # cache as garbage past the accepted length — masked until the
+        # next step's block (which always spans them) overwrites.
+        logits, (kk, vv) = self.model.apply(
+            params, tok_block, kv_cache=(kk, vv),
+            lengths=jnp.maximum(lengths - 1, 0))
+        return kk, vv, jnp.argmax(logits, axis=-1).astype(jnp.int32), logits
+
     def prefill(self, padded: np.ndarray, length: int, slot: int):
         jnp = self._jax.numpy
         self.kk, self.vv, first, logits = self._prefill(
@@ -203,11 +312,178 @@ class TransformerBackend:
             jnp.asarray(lengths))
         return np.asarray(nxt), np.asarray(logits)
 
+    def verify(self, tok_block: np.ndarray, lengths: np.ndarray):
+        jnp = self._jax.numpy
+        self.kk, self.vv, preds, logits = self._verify(
+            self.params, self.kk, self.vv, jnp.asarray(tok_block),
+            jnp.asarray(lengths))
+        return np.asarray(preds), np.asarray(logits)
+
     def swap_params(self, params) -> None:
         """Zero-downtime weight hot-swap: the next step (prefill or
         decode) runs the new weights; program shapes are unchanged so
         nothing recompiles.  In-flight sequences keep their KV cache —
         same contract as every serving system doing online updates."""
+        self.params = params
+
+
+class PagedTransformerBackend:
+    """TransformerBackend variant reading KV through per-slot page tables.
+
+    The KV pool is ``[L, pages, page_size, H, D]`` (init_kv_pages) and a
+    slot is a row of page ids, so a page holding a shared prompt-prefix
+    chunk can appear in many slots' rows at once — the mechanism behind
+    the prefix cache.  Every jitted program gathers the active tables
+    into the same dense ``[L, B, S, H, D]`` layout the plain backend
+    uses, runs the identical model code, then scatters only the written
+    positions back into their pages — so paging changes memory layout,
+    never arithmetic, and decode with the cache ON stays bit-exact vs a
+    cold dense prefill (pinned in tests/test_serving.py).  Shapes are
+    still fixed by the slot count and bucket menu: the gather/scatter
+    indices are data, not shape, so the compile cache stays the same
+    small finite set.
+
+    Page-id bookkeeping (allocation, refcounts, trie) lives in
+    :class:`~horovod_tpu.serving.prefix_cache.PrefixCache`; the engine
+    feeds admissions' page rows in via :meth:`attach_slot`."""
+
+    paged = True
+
+    def __init__(self, model, params, model_cfg, num_slots: int,
+                 max_seq_len: int, cache_pages: int = 0,
+                 page_size: int = 16):
+        import jax
+
+        self._jax = jax
+        self.model, self.params = model, params
+        self.num_slots, self.max_seq_len = num_slots, max_seq_len
+        if max_seq_len % page_size:
+            raise ValueError("max_seq_len must be a multiple of page_size")
+        self.page_size = page_size
+        self.pages_per_slot = max_seq_len // page_size
+        self.cache_pages = cache_pages
+        from horovod_tpu.models.transformer import init_kv_pages
+
+        num_pages = 1 + num_slots * self.pages_per_slot + cache_pages
+        self.pk, self.pv = init_kv_pages(model_cfg, num_pages, page_size)
+        # Host-side page tables: row s = the pages slot s reads/writes,
+        # in sequence order.  Row of zeros = detached (scratch page 0).
+        self.page_tables = np.zeros((num_slots, self.pages_per_slot),
+                                    np.int32)
+        self._prefill = jax.jit(self._prefill_fn, donate_argnums=(1, 2))
+        self._decode = jax.jit(self._decode_fn, donate_argnums=(1, 2))
+        self._verify = jax.jit(self._verify_fn, donate_argnums=(1, 2))
+
+    # -- page-table plumbing ------------------------------------------
+
+    def attach_slot(self, slot: int, page_row) -> None:
+        self.page_tables[slot] = np.asarray(page_row, np.int32)
+
+    def release_slot(self, slot: int) -> None:
+        self.page_tables[slot] = 0
+
+    def _gather(self, pk, pv, tables):
+        """Pages -> dense [L, B, S, H, D] views for the model's cache
+        path.  Pure indexing: the gathered values are exactly what a
+        dense per-slot cache would hold at the same positions."""
+        ell, _, ps, h, d = pk.shape
+        b, p = tables.shape
+        kd = pk[:, tables].reshape(ell, b, p * ps, h, d)
+        vd = pv[:, tables].reshape(ell, b, p * ps, h, d)
+        return kd, vd
+
+    # -- jitted programs ----------------------------------------------
+
+    def _prefill_fn(self, params, pk, pv, row, padded, suffix_len,
+                    prefix_len):
+        jax, jnp = self._jax, self._jax.numpy
+        kd, vd = self._gather(pk, pv, row[None, :])
+        # The suffix block enters through the cache path at position
+        # prefix_len: the causal mask exposes the cached prefix pages
+        # plus earlier block rows, which is exactly the context a cold
+        # full-prompt prefill would give each position.  prefix_len and
+        # suffix_len are traced scalars, so one program per bucket shape
+        # serves every (hit, miss) admission mix.
+        out = self.model.apply(params, padded, kv_cache=(kd, vd),
+                               lengths=prefix_len[None])
+        logits, (nk, nv) = out
+        if padded.shape[1] == 1:
+            last = logits[0]
+        else:
+            last = jax.lax.dynamic_slice(
+                logits, (0, suffix_len - 1, 0),
+                (1, 1, logits.shape[-1]))[0, 0]
+        # Scatter the whole slot range back: shared prefix pages receive
+        # the values they already held (a value-identical no-op — K/V at
+        # a position depend only on its token and rotary phase), pages
+        # past the suffix receive padding garbage the mask never exposes
+        # before decode overwrites it.
+        ell, _, ps, h, d = pk.shape
+        nk = nk[:, 0].reshape(ell, self.pages_per_slot, ps, h, d)
+        nv = nv[:, 0].reshape(ell, self.pages_per_slot, ps, h, d)
+        pk = pk.at[:, row].set(nk)
+        pv = pv.at[:, row].set(nv)
+        return pk, pv, jnp.argmax(last).astype(jnp.int32), last
+
+    def _decode_fn(self, params, pk, pv, tables, last_tokens, lengths):
+        jnp = self._jax.numpy
+        kd, vd = self._gather(pk, pv, tables)
+        w = jnp.maximum(lengths - 1, 0)  # see TransformerBackend note
+        logits, (nk, nv) = self.model.apply(
+            params, last_tokens[:, None], kv_cache=(kd, vd), lengths=w)
+        b = jnp.arange(tables.shape[0])
+        pidx = tables[b, w // self.page_size]
+        poff = w % self.page_size
+        pk = pk.at[:, pidx, poff].set(nk[:, b, w])
+        pv = pv.at[:, pidx, poff].set(nv[:, b, w])
+        return pk, pv, jnp.argmax(logits, axis=-1).astype(jnp.int32), logits
+
+    def _verify_fn(self, params, pk, pv, tables, tok_block, lengths):
+        jnp = self._jax.numpy
+        kd, vd = self._gather(pk, pv, tables)
+        w0 = jnp.maximum(lengths - 1, 0)
+        logits, (nk, nv) = self.model.apply(
+            params, tok_block, kv_cache=(kd, vd), lengths=w0)
+        b = jnp.arange(tables.shape[0])
+        offs = w0[:, None] + jnp.arange(tok_block.shape[1])[None, :]
+        pidx = jnp.take_along_axis(tables, offs // self.page_size, axis=1)
+        poff = offs % self.page_size
+        pk = pk.at[:, pidx, poff].set(nk[:, b[:, None], offs])
+        pv = pv.at[:, pidx, poff].set(nv[:, b[:, None], offs])
+        return pk, pv, jnp.argmax(logits, axis=-1).astype(jnp.int32), logits
+
+    # -- backend interface --------------------------------------------
+
+    def prefill(self, padded: np.ndarray, length: int, slot: int):
+        return self.prefill_prefixed(padded, length, slot, 0)
+
+    def prefill_prefixed(self, padded: np.ndarray, suffix_len: int,
+                         slot: int, prefix_len: int, prompt=None):
+        jnp = self._jax.numpy
+        row = jnp.asarray(self.page_tables[slot])
+        self.pk, self.pv, first, logits = self._prefill(
+            self.params, self.pk, self.pv, row, jnp.asarray(padded),
+            jnp.asarray(suffix_len, jnp.int32),
+            jnp.asarray(prefix_len, jnp.int32))
+        return int(first), np.asarray(logits)
+
+    def decode(self, last_tokens: np.ndarray, lengths: np.ndarray):
+        jnp = self._jax.numpy
+        self.pk, self.pv, nxt, logits = self._decode(
+            self.params, self.pk, self.pv,
+            jnp.asarray(self.page_tables), jnp.asarray(last_tokens),
+            jnp.asarray(lengths))
+        return np.asarray(nxt), np.asarray(logits)
+
+    def verify(self, tok_block: np.ndarray, lengths: np.ndarray):
+        jnp = self._jax.numpy
+        self.pk, self.pv, preds, logits = self._verify(
+            self.params, self.pk, self.pv,
+            jnp.asarray(self.page_tables), jnp.asarray(tok_block),
+            jnp.asarray(lengths))
+        return np.asarray(preds), np.asarray(logits)
+
+    def swap_params(self, params) -> None:
         self.params = params
 
 
@@ -229,20 +505,43 @@ class ServingEngine:
 
     def __init__(self, backend, config: ServingConfig | None = None,
                  collective=None, clock: Callable[[], float] = time.monotonic,
-                 on_complete: Callable[[Request], None] | None = None):
+                 on_complete: Callable[[Request], None] | None = None,
+                 tick_name: str | None = None):
         global _ACTIVE
         self.backend = backend
         self.config = config or ServingConfig()
         self.collective = collective
         self.clock = clock
         self.on_complete = on_complete
+        # Per-engine collective name so several engines (multi-model
+        # router) can share one control plane without their fixed-name
+        # tick allreduces colliding.
+        self.tick_name = tick_name or self.TICK_NAME
         self.queue: deque[Request] = deque()
         self.slots: list[Request | None] = [None] * self.config.num_slots
         self.last_tokens = np.zeros(self.config.num_slots, np.int32)
         self.lengths = np.zeros(self.config.num_slots, np.int32)
+        cfg = self.config
+        # Shared-prefix KV reuse: a paged backend brings its own pool
+        # dimensions; a stub opts in via prefix_cache_pages (its pages
+        # are notional — same admission bookkeeping, no arrays).
+        self.prefix: PrefixCache | None = None
+        if getattr(backend, "paged", False):
+            self.prefix = PrefixCache(cfg.num_slots, backend.pages_per_slot,
+                                      backend.cache_pages, backend.page_size)
+        elif cfg.prefix_cache_pages > 0 and \
+                hasattr(backend, "prefill_prefixed"):
+            if cfg.max_seq_len % cfg.page_size:
+                raise ValueError(
+                    "max_seq_len must be a multiple of page_size when the "
+                    "prefix cache is enabled")
+            self.prefix = PrefixCache(cfg.num_slots,
+                                      cfg.max_seq_len // cfg.page_size,
+                                      cfg.prefix_cache_pages, cfg.page_size)
         self.counters = dict.fromkeys(
             ("admitted", "evicted", "completed", "rejected", "retried",
-             "steps", "tokens"), 0)
+             "steps", "tokens", "prompt_tokens", "prefix_hits",
+             "prefix_hit_tokens", "spec_drafted", "spec_accepted"), 0)
         self._ttft_s: list[float] = []
         self._token_s: list[float] = []
         self._rid = itertools.count()
@@ -270,7 +569,19 @@ class ServingEngine:
         if len(req.prompt) > max(self.config.buckets) or \
                 len(req.prompt) >= self.config.max_seq_len:
             req.state, req.finish_reason = "DONE", "rejected"
+            req.error = (
+                f"prompt of {len(req.prompt)} tokens exceeds the largest "
+                f"prefill bucket ({max(self.config.buckets)}; extend the "
+                f"ladder with HVD_TPU_SERVE_BUCKETS) or the KV slot size "
+                f"(max_seq_len={self.config.max_seq_len}; raise with "
+                f"HVD_TPU_SERVE_MAX_LEN)")
             self.counters["rejected"] += 1
+            if self.collective is not None:
+                self.collective.timeline_instant(
+                    "SERVING_REJECT",
+                    f"req={req.rid} len={len(req.prompt)} "
+                    f"max_bucket={max(self.config.buckets)} "
+                    f"max_seq_len={self.config.max_seq_len}")
             return req
         self.queue.append(req)
         return req
@@ -287,14 +598,18 @@ class ServingEngine:
         done: list[Request] = []
         self._admit(done)
         if any(r is not None for r in self.slots):
-            nxt, logits = self.backend.decode(self.last_tokens, self.lengths)
-            now = self.clock()
-            for s, req in enumerate(self.slots):
-                if req is None:
-                    continue
-                self._take_token(req, s, int(nxt[s]), logits[s], now)
-                if req.state == "DONE":
-                    self._evict(req, s, done)
+            if self._spec_ready():
+                self._spec_step(done)
+            else:
+                nxt, logits = self.backend.decode(self.last_tokens,
+                                                  self.lengths)
+                now = self.clock()
+                for s, req in enumerate(self.slots):
+                    if req is None:
+                        continue
+                    self._take_token(req, s, int(nxt[s]), logits[s], now)
+                    if req.state == "DONE":
+                        self._evict(req, s, done)
         self.counters["steps"] += 1
         # Deliver completions BEFORE the collective tick: enqueue /
         # synchronize raise MembershipChanged on a reconfiguration, and a
@@ -323,10 +638,29 @@ class ServingEngine:
             if self.slots[s] is not None or not self.queue:
                 continue
             req = self.queue.popleft()
-            bucket = self._bucket(len(req.prompt))
+            hit = 0
+            if self.prefix is not None:
+                hit = self.prefix.lookup(req.prompt)
+                # A prefix-attached suffix prefill writes its bucket's
+                # block at position `hit`; shrink the hit until the
+                # block fits the slot's sequence range (a cold prompt
+                # always fits — submit() enforced the bucket ladder).
+                while hit and hit + self._bucket(len(req.prompt) - hit) \
+                        > cfg.max_seq_len:
+                    hit -= self.prefix.page_size
+                adm = self.prefix.admit(s, req.prompt, max_prefix_len=hit)
+                hit = adm.prefix_len
+                if getattr(self.backend, "paged", False):
+                    self.backend.attach_slot(s, adm.page_row)
+            suffix = req.prompt[hit:]
+            bucket = self._bucket(len(suffix))
             padded = np.zeros((1, bucket), np.int32)
-            padded[0, :len(req.prompt)] = req.prompt
-            first, logits = self.backend.prefill(padded, len(req.prompt), s)
+            padded[0, :len(suffix)] = suffix
+            if self.prefix is not None:
+                first, logits = self.backend.prefill_prefixed(
+                    padded, len(suffix), s, hit, req.prompt)
+            else:
+                first, logits = self.backend.prefill(padded, len(suffix), s)
             now = self.clock()
             req.state, req.slot = "ACTIVE", s
             req.ttft_s = now - req.submitted_t
@@ -334,10 +668,18 @@ class ServingEngine:
             self.slots[s] = req
             self.lengths[s] = len(req.prompt)
             self.counters["admitted"] += 1
+            self.counters["prompt_tokens"] += len(req.prompt)
+            if hit:
+                self.counters["prefix_hits"] += 1
+                self.counters["prefix_hit_tokens"] += hit
             if self.collective is not None:
                 self.collective.timeline_instant(
                     "SERVING_ADMIT", f"req={req.rid} slot={s} "
                     f"len={len(req.prompt)} bucket={bucket}")
+                if hit:
+                    self.collective.timeline_instant(
+                        "SERVING_PREFIX_HIT", f"req={req.rid} slot={s} "
+                        f"tokens={hit} suffix={len(suffix)}")
             self._take_token(req, s, first, logits, now)
             if req.state == "DONE":  # max_new_tokens == 1
                 self._evict(req, s, done)
@@ -362,10 +704,88 @@ class ServingEngine:
         elif total >= self.config.max_seq_len:
             req.state, req.finish_reason = "DONE", "max_seq_len"
 
+    def _spec_ready(self) -> bool:
+        """Speculate this step?  Needs a verify-capable backend, a draft
+        window, and room: the verify block writes k+1 KV positions from
+        the longest slot's write point, and letting it spill past
+        max_seq_len would clamp the write into earlier (live) positions.
+        A too-long step simply falls back to plain decode — two fixed
+        shapes total, both compiled once."""
+        k = self.config.spec_k
+        return (k > 0 and hasattr(self.backend, "verify")
+                and int(self.lengths.max()) + k <= self.config.max_seq_len)
+
+    def _propose(self, req: Request, k: int) -> list[int]:
+        """n-gram prompt lookup (PLD / Medusa-style, no draft model):
+        find the latest earlier occurrence of the trailing spec_ngram
+        tokens in prompt+generated history and draft its continuation,
+        cycling if the match runs out; fall back to the order-1 match,
+        then to repeating the last token.  Wrong drafts only cost the
+        difference between a verify and a decode step — acceptance is
+        checked token-by-token against the real model."""
+        hist = req.prompt + req.tokens
+        orders = (self.config.spec_ngram, 1) if self.config.spec_ngram > 1 \
+            else (1,)
+        for m in orders:
+            if len(hist) < m + 1:
+                continue
+            pat = hist[-m:]
+            for i in range(len(hist) - m - 1, -1, -1):
+                if hist[i:i + m] == pat:
+                    cont = hist[i + m:i + m + k]
+                    out = list(cont)
+                    while len(out) < k:
+                        out.extend(cont[:k - len(out)])
+                    return out[:k]
+        return [hist[-1]] * k
+
+    def _spec_step(self, done: list[Request]) -> None:
+        k = self.config.spec_k
+        drafts = np.zeros((self.config.num_slots, k), np.int32)
+        for s, req in enumerate(self.slots):
+            if req is not None:
+                drafts[s] = self._propose(req, k)
+        tok_block = np.concatenate([self.last_tokens[:, None], drafts],
+                                   axis=1)
+        preds, logits = self.backend.verify(tok_block, self.lengths)
+        now = self.clock()
+        for s, req in enumerate(self.slots):
+            if req is None:
+                continue
+            # preds[s, j] is the model's next token after consuming block
+            # column j, so column 0 is exactly plain decode's output:
+            # accept drafts left-to-right while they match what the model
+            # would have produced, then take the model's own prediction
+            # at the first divergence (or the bonus k+1'th token when
+            # everything matched).  Greedy, so the emitted stream is
+            # bit-identical to plain decode — speculation only changes
+            # how many steps it takes.
+            taken = 0
+            while taken < k and req.state == "ACTIVE" and \
+                    int(preds[s, taken]) == int(drafts[s, taken]):
+                self._take_token(req, s, int(drafts[s, taken]),
+                                 logits[s, taken], now)
+                taken += 1
+            if req.state == "ACTIVE":
+                self._take_token(req, s, int(preds[s, taken]),
+                                 logits[s, taken], now)
+            self.counters["spec_drafted"] += k
+            self.counters["spec_accepted"] += taken
+            if self.collective is not None and taken:
+                self.collective.timeline_instant(
+                    "SERVING_SPEC_ACCEPT",
+                    f"req={req.rid} slot={s} accepted={taken}/{k}")
+            if req.state == "DONE":
+                self._evict(req, s, done)
+
     def _evict(self, req: Request, slot: int, done: list[Request]) -> None:
         self.slots[slot] = None
         self.last_tokens[slot] = 0
         self.lengths[slot] = 0
+        if self.prefix is not None:
+            self.prefix.release(slot)
+        if getattr(self.backend, "paged", False):
+            self.backend.release_slot(slot)
         self.counters["evicted"] += 1
         self.counters["completed"] += 1
         if self.collective is not None:
@@ -385,7 +805,7 @@ class ServingEngine:
                         self._occupancy(), self.done_flag], np.float32)
         # Fixed name + shape + dtype every tick: after the first step the
         # signature is a response-cache hit, never renegotiated.
-        h = self.collective.enqueue(self.TICK_NAME, vec, OP_ALLREDUCE)
+        h = self.collective.enqueue(self.tick_name, vec, OP_ALLREDUCE)
         agg = self.collective.synchronize(h)
         self.fleet = dict(zip(("active", "queued", "admitted", "evicted",
                                "completed", "tokens", "steps", "occupancy",
@@ -426,6 +846,15 @@ class ServingEngine:
             "token_p50_ms": _pctile(self._token_s, 50) * 1e3,
             "token_p99_ms": _pctile(self._token_s, 99) * 1e3,
             "kv_slot_occupancy": self._occupancy(),
+            "prefix_hits": c["prefix_hits"],
+            "prefix_hit_tokens": c["prefix_hit_tokens"],
+            "prefix_evictions": self.prefix.evictions if self.prefix else 0,
+            "prefix_hit_rate": (c["prefix_hit_tokens"]
+                                / max(c["prompt_tokens"], 1)),
+            "spec_drafted": c["spec_drafted"],
+            "spec_accepted": c["spec_accepted"],
+            "spec_accept_rate": (c["spec_accepted"]
+                                 / max(c["spec_drafted"], 1)),
         }
 
 
@@ -445,6 +874,5 @@ def serving_stats() -> dict:
     ``ServingEngine`` has been constructed in this process — mirrors the
     ``control_plane_stats()`` contract."""
     if _ACTIVE is None:
-        return {k: 0 if isinstance(v, int) else 0.0 for k, v in
-                zip(_STATS_KEYS, (0,) * 9 + (0.0,) * 5)}
+        return {k: 0.0 if k in _FLOAT_STATS else 0 for k in _STATS_KEYS}
     return _ACTIVE.stats()

@@ -37,12 +37,23 @@ class Workload:
     long_new: int = 64
     long_frac: float = 0.1
     vocab: int = 256
+    # Shared-system-prompt traffic: this fraction of arrivals opens with
+    # the same deterministic shared_prefix_len-token prefix (then a
+    # random tail drawn from prompt_lens as usual) — the mix that makes
+    # a prefix cache pay.  0.0 keeps every prompt fully random.
+    shared_frac: float = 0.0
+    shared_prefix_len: int = 0
 
 
 def make_arrivals(w: Workload) -> list[tuple[float, list[int], int]]:
     """``[(arrival_t, prompt, max_new_tokens), ...]`` — pure function of
     the workload, shared by every mode/replica being compared."""
     rng = random.Random(w.seed)
+    # The shared system prompt is a function of the seed alone, not of
+    # the arrival sequence — every replica (and every cache-on/off
+    # comparison run) sees the identical prefix bytes.
+    srng = random.Random(w.seed ^ 0x5EED)
+    shared = [srng.randrange(1, w.vocab) for _ in range(w.shared_prefix_len)]
     out, t = [], 0.0
     while True:
         t += rng.expovariate(w.qps)
@@ -50,6 +61,8 @@ def make_arrivals(w: Workload) -> list[tuple[float, list[int], int]]:
             return out
         n = rng.choice(w.prompt_lens)
         prompt = [rng.randrange(1, w.vocab) for _ in range(n)]
+        if shared and rng.random() < w.shared_frac:
+            prompt = shared + prompt
         max_new = w.long_new if rng.random() < w.long_frac else w.short_new
         out.append((t, prompt, max_new))
 

@@ -126,10 +126,15 @@ class _Replica:
 def run_fleet(n: int = 2, qps: float = 40.0, duration_s: float = 4.0,
               kill: bool = True, join: bool = True, swap: bool = False,
               seed: int = 0, step_s: float = 0.003,
-              timeout_s: float = 120.0) -> dict:
+              timeout_s: float = 120.0, prefix_cache: bool = False,
+              spec_k: int = 0) -> dict:
     """Run the soak scenario; returns metrics and raises AssertionError on
     any lost/corrupted request, a disk read on the clone path, or a hang
-    (everything is deadline-bounded)."""
+    (everything is deadline-bounded).  ``prefix_cache``/``spec_k`` turn
+    the engine fast paths on inside every worker: the stub's completion
+    stream is a pure function of the prompt either way, so the
+    zero-lost/zero-corrupted assertions are unchanged — which is exactly
+    the point of soaking with them enabled."""
     t_start = time.monotonic()
     port = _free_port()
     env = {**os.environ, **FLEET_ENV, "PYTHONPATH": _REPO,
@@ -141,6 +146,11 @@ def run_fleet(n: int = 2, qps: float = 40.0, duration_s: float = 4.0,
            "HVD_TPU_SERVE_QUEUE_HIGH": "2",
            "HVD_TPU_SERVE_P99_MS": "25",
            "HVD_TPU_SERVE_COOLDOWN_S": "0.5"}
+    if prefix_cache:
+        env["HVD_TPU_SERVE_PREFIX_PAGES"] = "16"
+        env["HVD_TPU_SERVE_PAGE_TOKENS"] = "8"
+    if spec_k:
+        env["HVD_TPU_SERVE_SPEC_K"] = str(spec_k)
     argv = [sys.executable, "-m", "horovod_tpu.serving.worker"]
     fleet = [_Replica(argv + [str(r), str(n), str(port)], env)
              for r in range(n)]

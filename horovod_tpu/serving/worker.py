@@ -152,7 +152,12 @@ def main(argv=None) -> int:
     else:
         state = make_weights(version)
     step_s = float(os.environ.get("HVD_TPU_SERVE_STEP_S", "0.003"))
-    cfg = ServingConfig(num_slots=4, buckets=(8, 16, 32), max_seq_len=128)
+    # Geometry is pinned (the soak's request mix is sized to it); the
+    # prefix-cache and speculation knobs ride the env so the chaos soak
+    # can run with both fast paths on — completions must stay identical
+    # (the stub's stream is a pure function of the prompt either way).
+    cfg = ServingConfig.from_env(num_slots=4, buckets=(8, 16, 32),
+                                 max_seq_len=128)
     serving = ServingEngine(
         StubBackend(cfg.num_slots, VOCAB, step_s=step_s), cfg,
         collective=eng,

@@ -725,6 +725,36 @@ def serve_cooldown_s() -> float:
     return _serve_number("SERVE_COOLDOWN_S", 2.0, float, floor=0.0)
 
 
+def serve_prefix_pages() -> int:
+    """``HVD_TPU_SERVE_PREFIX_PAGES`` — shared-prefix KV cache slack in
+    pages beyond the slots' own working set (default 0 = cache off):
+    evicted requests' prompt-prefix chunks stay resident in up to this
+    many pages for later admissions to attach to
+    (serving/prefix_cache.py)."""
+    return _serve_number("SERVE_PREFIX_PAGES", 0, int, floor=0)
+
+
+def serve_page_tokens() -> int:
+    """``HVD_TPU_SERVE_PAGE_TOKENS`` — tokens per KV page, the unit of
+    prefix sharing (default 16).  ``HVD_TPU_SERVE_MAX_LEN`` must be a
+    multiple when the prefix cache is on."""
+    return _serve_number("SERVE_PAGE_TOKENS", 16, int, floor=1)
+
+
+def serve_spec_k() -> int:
+    """``HVD_TPU_SERVE_SPEC_K`` — speculative decoding draft window: the
+    engine proposes this many tokens per slot per step (n-gram prompt
+    lookup) and verifies them in one fixed-shape batched step (default
+    0 = speculation off)."""
+    return _serve_number("SERVE_SPEC_K", 0, int, floor=0)
+
+
+def serve_slo_ms() -> float:
+    """``HVD_TPU_SERVE_SLO_MS`` — default TTFT SLO in ms a routed model
+    is judged against (serving/router.py ``ModelSpec``; default 100)."""
+    return _serve_number("SERVE_SLO_MS", 100.0, float, floor=0.0)
+
+
 def serve_qps() -> float:
     """``HVD_TPU_SERVE_QPS`` — Poisson arrival rate a ``--serve`` replica
     drives at itself (default 20)."""

@@ -17,6 +17,14 @@ The load-bearing claims, each pinned here:
   drain barrier), mid-batch eviction of finished/over-length sequences,
   the static-batching baseline barrier, and the stats surface
   (``hvd.serving_stats()``).
+* **Prefix cache** — decode with the radix-trie KV cache ON is bitwise
+  identical to a cold prefill (tokens AND logits), on the stub and on
+  the real paged transformer backend; refcounted pages pin while
+  referenced and only refs==0 leaves LRU-evict under pressure.
+* **Speculative decoding** — greedy n-gram speculation emits the exact
+  plain-decode stream on both the reject path (positional stub: nothing
+  ever accepted) and the accept path (periodic stub: fewer steps, same
+  tokens), and bit-exact tokens on the real transformer.
 
 The chaos soak (grow + SIGKILL under load, serving/soak.py) runs under
 ``-m slow``; SERVING_SOAK_REPS repeats it.
@@ -119,6 +127,12 @@ def test_unbucketable_prompt_rejected_not_queued():
     req = eng.submit(list(range(9)), 4)  # > max bucket
     assert req.state == "DONE" and req.finish_reason == "rejected"
     assert not eng.queue and eng.counters["rejected"] == 1
+    # Not silent: the error names the limit hit and the knob that
+    # raises it, so the caller can act without reading engine source.
+    assert req.error is not None and "9 tokens" in req.error
+    assert "HVD_TPU_SERVE_BUCKETS" in req.error
+    assert "HVD_TPU_SERVE_MAX_LEN" in req.error
+    assert eng.stats()["rejected"] == 1
 
 
 def test_eos_finishes_early():
@@ -275,6 +289,212 @@ def test_hot_swap_changes_output_without_recompile(small_model):
 
 
 # ---------------------------------------------------------------------------
+# Prefix cache: radix-trie refcounting + bit-exact prefix-attached decode
+# ---------------------------------------------------------------------------
+
+def test_prefix_cache_trie_eviction_and_refcount_pinning():
+    # Pure-python unit: referenced pages pin, only refs==0 leaves evict,
+    # and eviction recycles pages without ever touching a live path.
+    from horovod_tpu.serving.prefix_cache import PrefixCache
+
+    pc = PrefixCache(num_slots=2, pages_per_slot=4, cache_pages=2,
+                     page_size=4)
+    hot = list(range(100, 113))  # 13 tokens -> 3 donated chunks
+    a0 = pc.admit(0, hot)
+    assert a0.prefix_len == 0 and len(a0.donated) == 3
+    assert pc.lookup(hot) == 12
+    # A second slot attaches to the donated chunks by reference.
+    a1 = pc.admit(1, hot, max_prefix_len=pc.lookup(hot))
+    assert a1.prefix_len == 12 and a1.shared == a0.donated
+    pc.release(1)
+    # Churn distinct prompts through slot 1 until the pool must evict.
+    for n in range(8):
+        pc.admit(1, [200 + 16 * n + i for i in range(13)])
+        pc.release(1)
+    assert pc.evictions > 0
+    # Slot 0 still holds refs on the hot path: it must have survived
+    # every eviction, and a fresh admission still fully shares it.
+    assert pc.lookup(hot) == 12
+    a2 = pc.admit(1, hot, max_prefix_len=12)
+    assert a2.prefix_len == 12 and a2.shared == a0.donated
+    pc.release(1)
+    pc.release(0)
+    # With every ref dropped the hot chunks are evictable in turn.
+    for n in range(8):
+        pc.admit(0, [600 + 16 * n + i for i in range(13)])
+        pc.release(0)
+    assert pc.lookup(hot) < 12
+    # Conservation: pages never leak — everything resident or free.
+    assert pc.resident_pages() + len(pc._free) == pc.num_pages - 1
+
+
+def _make_paged_engine(small_model, num_slots=2, cache_pages=8):
+    from horovod_tpu.serving.engine import PagedTransformerBackend
+
+    model, params, mcfg = small_model
+    backend = PagedTransformerBackend(model, params, mcfg, num_slots,
+                                      max_seq_len=64,
+                                      cache_pages=cache_pages, page_size=8)
+    return ServingEngine(backend, ServingConfig(
+        num_slots=num_slots, buckets=(8, 16), max_seq_len=64,
+        record_logits=True, prefix_cache_pages=cache_pages, page_size=8))
+
+
+def test_prefix_cache_bit_exact_vs_cold(small_model):
+    # Three prompts share a 12-token system prefix.  The first admission
+    # donates its chunks; the later two attach to the shared page and
+    # prefill only their suffix — while decoding CONCURRENTLY through the
+    # same shared page.  Tokens and logits must be bitwise identical to a
+    # cold dense engine that re-prefills everything.
+    rng = np.random.RandomState(3)
+    shared = list(map(int, rng.randint(0, 64, 12)))
+    tails = [list(map(int, rng.randint(0, 64, 4))) for _ in range(3)]
+
+    warm = _make_paged_engine(small_model)
+    first = warm.submit(shared + tails[0], 6)
+    warm.run_until_idle()
+    later = [warm.submit(shared + t, 6) for t in tails[1:]]  # same batch
+    warm.run_until_idle()
+    st = warm.stats()
+    assert st["prefix_hits"] == 2 and st["prefix_hit_tokens"] == 16
+    assert st["prefix_hit_rate"] > 0.0
+
+    cold = _make_engine(small_model, num_slots=2)
+    for req, tail in zip([first] + later, tails):
+        solo = cold.submit(shared + tail, 6)
+        cold.run_until_idle()
+        assert solo.tokens == req.tokens, (tail, solo.tokens, req.tokens)
+        for a, b in zip(solo.logits, req.logits):
+            assert np.array_equal(a, b), \
+                "prefix-attached decode diverged bitwise from cold prefill"
+
+
+# ---------------------------------------------------------------------------
+# Speculative decoding: lossless greedy acceptance, both paths
+# ---------------------------------------------------------------------------
+
+def test_spec_decode_reject_path_identical_stream():
+    # The positional stub's next token depends on absolute position, so
+    # lookahead drafts never verify: speculation must degrade to plain
+    # decode with the identical stream, not corrupt it.
+    from horovod_tpu.serving.worker import expected_completion
+
+    eng = ServingEngine(StubBackend(2), ServingConfig(
+        num_slots=2, buckets=(8,), max_seq_len=64, spec_k=3))
+    prompt = [3, 1, 4, 1, 5]
+    req = eng.submit(prompt, 8)
+    eng.run_until_idle()
+    assert req.tokens == expected_completion(prompt, 8)
+    st = eng.stats()
+    assert st["spec_drafted"] > 0 and st["spec_accepted"] == 0
+
+
+def test_spec_decode_accept_path_same_tokens_fewer_steps():
+    # The periodic stub is predictable, so the n-gram proposer's drafts
+    # verify: same tokens as plain decode in strictly fewer steps.
+    def make(k):
+        return ServingEngine(StubBackend(1, period=4), ServingConfig(
+            num_slots=1, buckets=(8,), max_seq_len=64, spec_k=k))
+
+    plain, spec = make(0), make(3)
+    prompt = [1, 2, 3]
+    a = plain.submit(prompt, 12)
+    plain.run_until_idle()
+    b = spec.submit(prompt, 12)
+    spec.run_until_idle()
+    assert a.tokens == b.tokens
+    st = spec.stats()
+    assert st["spec_accepted"] > 0 and st["spec_accept_rate"] > 0.0
+    assert spec.counters["steps"] < plain.counters["steps"]
+
+
+def test_spec_decode_bit_exact_vs_plain(small_model):
+    # Real transformer: greedy speculation emits the exact plain-decode
+    # token stream.  Logits ride a different (block-verify) program
+    # shape, so they are compared to tolerance, tokens bitwise.
+    from horovod_tpu.serving.engine import TransformerBackend
+
+    model, params, mcfg = small_model
+    backend = TransformerBackend(model, params, mcfg, 2, max_seq_len=64)
+    spec_eng = ServingEngine(backend, ServingConfig(
+        num_slots=2, buckets=(8, 16), max_seq_len=64, spec_k=2,
+        record_logits=True))
+    rng = np.random.RandomState(1)
+    prompts = [list(map(int, rng.randint(0, 64, n))) for n in (5, 9)]
+    reqs = [spec_eng.submit(p, 7) for p in prompts]
+    spec_eng.run_until_idle()
+    assert spec_eng.counters["spec_drafted"] > 0
+
+    plain = _make_engine(small_model, num_slots=2)
+    for req, prompt in zip(reqs, prompts):
+        solo = plain.submit(prompt, 7)
+        plain.run_until_idle()
+        assert solo.tokens == req.tokens, (prompt, solo.tokens, req.tokens)
+        assert len(solo.logits) == len(req.logits)
+        for a, b in zip(solo.logits, req.logits):
+            np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# Multi-model router + cross-model budget arbitration
+# ---------------------------------------------------------------------------
+
+def test_router_routes_least_loaded_and_scores_slo():
+    from horovod_tpu.serving.router import ModelSpec, Router
+
+    def make():
+        return ServingEngine(StubBackend(2), ServingConfig(
+            num_slots=2, buckets=(8,), max_seq_len=64))
+
+    router = Router()
+    router.add_model(ModelSpec("chat", slo_ttft_ms=1000.0), [make(), make()])
+    router.add_model(ModelSpec("code", slo_ttft_ms=1000.0), [make()])
+    with pytest.raises(KeyError):
+        router.submit("nope", [1], 1)
+    for i in range(6):
+        router.submit("chat" if i % 2 else "code", [1, 2, i], 4)
+    router.run_until_idle()
+    st = router.stats()
+    assert st["chat"]["completed"] == 3 and st["code"]["completed"] == 3
+    assert st["chat"]["slo_attainment"] == 1.0  # generous SLO, tiny load
+    # Least-loaded admission actually spread chat across both replicas.
+    assert all(e.counters["completed"] >= 1
+               for e in router._engines["chat"])
+    # remove_replica never retires the last seat of a model.
+    assert router.remove_replica("code") is None
+    assert router.remove_replica("chat") is not None
+
+
+def test_router_autoscaler_pairs_shrink_with_grow_under_budget():
+    from horovod_tpu.serving.autoscale import AutoscaleConfig
+    from horovod_tpu.serving.router import (ModelSpec, Router,
+                                            RouterAutoscaler)
+
+    def make():
+        return ServingEngine(StubBackend(2), ServingConfig(
+            num_slots=2, buckets=(8,), max_seq_len=64))
+
+    specs = [ModelSpec("chat"), ModelSpec("code")]
+    router = Router()
+    router.add_model(specs[0], [make()])
+    router.add_model(specs[1], [make(), make()])
+    for _ in range(20):  # chat is pressured, code fully idle
+        router.submit("chat", [1, 2], 4)
+    t = [0.0]
+    auto = RouterAutoscaler(
+        specs, budget=3,
+        config=AutoscaleConfig(min_replicas=1, max_replicas=4,
+                               queue_high=4.0, idle_s=1.0, cooldown_s=0.0),
+        clock=lambda: t[0])
+    # Budget full, donor's idle window not yet elapsed: the grow waits.
+    assert auto.decide(router) == []
+    t[0] += 2.0
+    # Now code's policy independently wants to shrink: the paired move
+    # migrates its seat to chat without ever exceeding the budget.
+    assert auto.decide(router) == [("code", "shrink"), ("chat", "grow")]
+
+
+# ---------------------------------------------------------------------------
 # The serving.tick collective: fleet counters + response-cache warmth
 # ---------------------------------------------------------------------------
 
@@ -338,13 +558,19 @@ def test_autoscaler_grow_shrink_cooldown():
 def test_serving_autoscale_soak():
     """Grow under load + SIGKILL mid-traffic + fleet-wide hot swap: no
     accepted request lost or corrupted, weights cloned over the data
-    plane with zero disk reads, bounded end to end."""
+    plane with zero disk reads, bounded end to end.  The chaos scenario
+    runs with the prefix cache and speculative decoding enabled in every
+    worker — the fast paths must not change a single completion CRC (the
+    stub's stream is a pure function of the prompt), and a replica dying
+    with slots attached to shared pages must not poison survivors'
+    retries."""
     from horovod_tpu.serving import soak
 
     reps = int(os.environ.get("SERVING_SOAK_REPS", "1"))
     for rep in range(reps):
         r = soak.run_fleet(n=3, qps=40.0, duration_s=4.0, kill=True,
-                           join=True, swap=(rep % 2 == 0), seed=rep)
+                           join=True, swap=(rep % 2 == 0), seed=rep,
+                           prefix_cache=True, spec_k=3)
         assert r["lost"] == 0 and r["completed"] == r["accepted"], r
         assert r["join_disk_reads"] == 0, r
         assert r["killed"] == 1, r
